@@ -1,0 +1,51 @@
+"""Single config object for the framework.
+
+The reference hard-codes every knob: TCP port 1040
+(src/bin/mrcoordinator.rs:31, src/bin/mrworker.rs:21), 5 s lease timeout
+(src/mr/coordinator.rs:70,86), 5-tick detector period
+(src/bin/mrcoordinator.rs:47), 1 s renewal period (src/bin/mrworker.rs:141),
+input path template ``data/gut-{m}.txt`` (src/mr/worker.rs:67) and the
+intermediate/output file templates (src/mr/worker.rs:85,121,167). Here they
+are all fields of one dataclass.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class Config:
+    # ---- Job shape (reference: argv of mrcoordinator/mrworker) ----
+    map_n: int = 6          # number of map tasks (chunks)
+    reduce_n: int = 4       # number of reduce partitions
+    worker_n: int = 1       # registration barrier size (coordinator.rs:42-44)
+
+    # ---- Data plane ----
+    chunk_bytes: int = 1 << 22      # bytes per map chunk fed to the device
+    max_word_len: int = 64          # device tokenizer halo / truncation cap
+    merge_capacity: int = 1 << 21   # running distinct-key capacity on device
+    bucket_capacity_factor: float = 2.0  # all_to_all per-bucket slack
+    device: str = "auto"            # "auto" | "tpu" | "cpu"
+    mesh_shape: Optional[int] = None  # devices in the 1-D mesh (None = all)
+
+    # ---- Control plane (reference timings preserved) ----
+    host: str = "127.0.0.1"
+    port: int = 1040
+    lease_timeout_s: float = 5.0     # coordinator.rs:70,86
+    lease_check_period_s: float = 5.0  # mrcoordinator.rs:47-52 (1 Hz x 5 ticks)
+    lease_renew_period_s: float = 1.0  # mrworker.rs:141 (fixed: map side too)
+    poll_retry_s: float = 1.0        # worker sleep on -2/-3 (mrworker.rs:52,58)
+
+    # ---- Paths ----
+    input_dir: str = "data"
+    input_pattern: str = "*.txt"
+    work_dir: str = "mr-work"        # intermediates / checkpoints
+    output_dir: str = "mr-out"       # final per-partition outputs
+
+    def __post_init__(self) -> None:
+        if self.map_n <= 0 or self.reduce_n <= 0 or self.worker_n <= 0:
+            raise ValueError("map_n, reduce_n, worker_n must be positive")
+        if self.chunk_bytes <= 2 * self.max_word_len:
+            raise ValueError("chunk_bytes too small for max_word_len halo")

@@ -1,0 +1,11 @@
+from mapreduce_rust_tpu.core.hashing import (  # noqa: F401
+    H1_INIT,
+    H1_MULT,
+    H2_INIT,
+    H2_MULT,
+    SENTINEL,
+    byte_class_tables,
+    hash_word,
+    hash_words,
+)
+from mapreduce_rust_tpu.core.kv import KVBatch  # noqa: F401

@@ -1,0 +1,109 @@
+"""Hash and byte-class definitions shared by device and host.
+
+Keys on the TPU data plane are a pair of independent 32-bit polynomial
+hashes (an effective 64-bit key — TPUs have no fast native 64-bit integer
+path, so we keep two uint32 lanes instead). The host dictionary
+(`runtime/dictionary.py`, `native/loader.cpp`) computes the *same* pair so
+hash→word join at egress is exact.
+
+This replaces the reference's `std::collections::hash_map::DefaultHasher`
+keyed on the word string (src/mr/worker.rs:111-115): there the hash only
+routed pairs to reduce partitions (hash % reduce_n, worker.rs:129) and the
+string itself travelled through the shuffle files. Here the hash pair *is*
+the shuffled key; word bytes never cross the interconnect.
+
+Tokenization semantics match the reference word-count app
+(src/app/wc.rs:6-13): characters matching ``[^\\w\\s]`` are deleted (so
+"don't" → "dont" — punctuation does NOT split a word), then the text splits
+on whitespace. No lowercasing (case-sensitive counts). On the byte level:
+
+- whitespace  = ASCII space, \\t, \\n, \\r, \\v, \\f  → token boundary
+- word chars  = [A-Za-z0-9_] and any byte >= 0x80 (UTF-8 continuation /
+  lead bytes stay inside words, approximating unicode ``\\w``)
+- everything else (ASCII punctuation) → deleted, does not break the token
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+# Two independent multiplicative-polynomial hash lanes (uint32, wrapping).
+# h <- h * MULT + (byte + 1)   per word byte; pair (h1, h2) is the key.
+H1_MULT = np.uint32(0x01000193)  # FNV-1a prime
+H1_INIT = np.uint32(0x811C9DC5)  # FNV offset basis
+H2_MULT = np.uint32(1000003)     # CPython string-hash prime
+H2_INIT = np.uint32(0x9E3779B9)  # golden ratio
+
+# Padding / invalid-slot key. A real word hashing to the sentinel pair is
+# harmless: padding contributes count 0 to the merged segment.
+SENTINEL = np.uint32(0xFFFFFFFF)
+
+_WHITESPACE = b" \t\n\r\x0b\x0c"
+
+
+@functools.lru_cache(maxsize=None)
+def byte_class_tables() -> tuple[np.ndarray, np.ndarray]:
+    """256-entry lookup tables: (is_whitespace, is_word_char) as uint8."""
+    ws = np.zeros(256, dtype=np.uint8)
+    for b in _WHITESPACE:
+        ws[b] = 1
+    wc = np.zeros(256, dtype=np.uint8)
+    for b in range(ord("a"), ord("z") + 1):
+        wc[b] = 1
+    for b in range(ord("A"), ord("Z") + 1):
+        wc[b] = 1
+    for b in range(ord("0"), ord("9") + 1):
+        wc[b] = 1
+    wc[ord("_")] = 1
+    wc[0x80:] = 1  # non-ASCII bytes continue a word
+    return ws, wc
+
+
+def hash_word(word: bytes) -> tuple[int, int]:
+    """Host-side reference hash of one already-cleaned word (word chars only)."""
+    h1 = int(H1_INIT)
+    h2 = int(H2_INIT)
+    m1 = int(H1_MULT)
+    m2 = int(H2_MULT)
+    for b in word:
+        h1 = (h1 * m1 + b + 1) & 0xFFFFFFFF
+        h2 = (h2 * m2 + b + 1) & 0xFFFFFFFF
+    return h1, h2
+
+
+def hash_words(words: list[bytes]) -> np.ndarray:
+    """Vectorized host hash of many words → uint32 array [n, 2]."""
+    out = np.empty((len(words), 2), dtype=np.uint32)
+    for i, w in enumerate(words):
+        out[i] = hash_word(w)
+    return out
+
+
+def tokenize_host(data: bytes) -> list[bytes]:
+    """Pure-host tokenizer with identical semantics to the device kernel.
+
+    Used by tests as the oracle path and by the dictionary builder fallback.
+    Returns the *cleaned* words (punctuation stripped, unsplit).
+    """
+    ws, wc = byte_class_tables()
+    arr = np.frombuffer(data, dtype=np.uint8)
+    is_ws = ws[arr].astype(bool)
+    is_wc = wc[arr].astype(bool)
+    words: list[bytes] = []
+    cur: list[int] = []
+    started = False
+    for b, w, c in zip(arr, is_ws, is_wc):
+        if w:
+            if started and cur:
+                words.append(bytes(cur))
+            cur = []
+            started = False
+        else:
+            started = True
+            if c:
+                cur.append(int(b))
+    if started and cur:
+        words.append(bytes(cur))
+    return words

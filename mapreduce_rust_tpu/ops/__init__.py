@@ -1,0 +1,8 @@
+from mapreduce_rust_tpu.ops.tokenize import tokenize_and_hash  # noqa: F401
+from mapreduce_rust_tpu.ops.groupby import (  # noqa: F401
+    count_unique,
+    merge_batches,
+    segment_reduce_sorted,
+    sort_kv,
+)
+from mapreduce_rust_tpu.ops.partition import bucket_scatter  # noqa: F401

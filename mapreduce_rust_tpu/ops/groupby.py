@@ -1,0 +1,107 @@
+"""Sort + segment group-by reduce — the TPU-native reduce engine.
+
+Replaces the reference reduce path (src/mr/worker.rs:157-193): there, all
+pairs of a partition are parsed from files, ``sort_by`` key
+(worker.rs:162-164), then a streaming group-by calls the reduce UDF per key
+run (worker.rs:169-184 — with the last group silently dropped, a bug we do
+not reproduce). Here the same shape is ``lax.sort`` on the hash-pair key
+(lexicographic, num_keys=2) followed by segment-boundary detection and
+``jax.ops.segment_sum`` — every group flushed, including the last, by
+construction.
+
+All functions keep static shapes: outputs are padded to the input capacity
+with SENTINEL keys so they stay jit/shard_map-friendly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from mapreduce_rust_tpu.core.hashing import SENTINEL
+from mapreduce_rust_tpu.core.kv import KVBatch
+
+
+def sort_kv(batch: KVBatch) -> KVBatch:
+    """Sort records by (k1, k2). SENTINEL-keyed padding sorts to the end."""
+    k1, k2, value, valid = jax.lax.sort(
+        (batch.k1, batch.k2, batch.value, batch.valid.astype(jnp.int32)),
+        num_keys=2,
+        is_stable=True,
+    )
+    return KVBatch(k1, k2, value, valid.astype(bool))
+
+
+def segment_reduce_sorted(batch: KVBatch, op: str = "sum") -> KVBatch:
+    """Reduce a key-sorted batch: one output record per distinct key.
+
+    op: "sum" (word count totals), "max", or "min" over values.
+    Output is padded to the same capacity; slot i holds the i-th distinct
+    key (sorted ascending), so real records sit at the front.
+    """
+    n = batch.capacity
+    prev_k1 = jnp.concatenate([batch.k1[:1], batch.k1[:-1]])
+    prev_k2 = jnp.concatenate([batch.k2[:1], batch.k2[:-1]])
+    first = jnp.arange(n) == 0
+    boundary = first | (batch.k1 != prev_k1) | (batch.k2 != prev_k2)
+    # Padding (SENTINEL,SENTINEL) forms at most one trailing segment.
+    seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+
+    masked_val = jnp.where(batch.valid, batch.value, 0)
+    if op == "sum":
+        totals = jax.ops.segment_sum(masked_val, seg, num_segments=n)
+    elif op == "max":
+        big = jnp.where(batch.valid, batch.value, jnp.iinfo(jnp.int32).min)
+        totals = jax.ops.segment_max(big, seg, num_segments=n)
+    elif op == "min":
+        small = jnp.where(batch.valid, batch.value, jnp.iinfo(jnp.int32).max)
+        totals = jax.ops.segment_min(small, seg, num_segments=n)
+    else:
+        raise ValueError(f"unknown reduce op: {op}")
+
+    live = jax.ops.segment_sum(batch.valid.astype(jnp.int32), seg, num_segments=n)
+    uk1 = jax.ops.segment_max(jnp.where(boundary, batch.k1, 0), seg, num_segments=n)
+    uk2 = jax.ops.segment_max(jnp.where(boundary, batch.k2, 0), seg, num_segments=n)
+
+    # Slot j is real iff j < number of segments containing >=1 valid record.
+    # Valid records sort before padding, so those segments are a prefix.
+    slot_valid = live > 0
+    sent = jnp.uint32(SENTINEL)
+    return KVBatch(
+        k1=jnp.where(slot_valid, uk1, sent),
+        k2=jnp.where(slot_valid, uk2, sent),
+        value=jnp.where(slot_valid, totals, 0),
+        valid=slot_valid,
+    )
+
+
+def count_unique(batch: KVBatch) -> KVBatch:
+    """Sort + sum-reduce: (distinct keys, summed values). The map-side
+    combiner (word count's reduce is associative, so partial counts merge)."""
+    return segment_reduce_sorted(sort_kv(batch), op="sum")
+
+
+def concat_batches(a: KVBatch, b: KVBatch) -> KVBatch:
+    return KVBatch(
+        k1=jnp.concatenate([a.k1, b.k1]),
+        k2=jnp.concatenate([a.k2, b.k2]),
+        value=jnp.concatenate([a.value, b.value]),
+        valid=jnp.concatenate([a.valid, b.valid]),
+    )
+
+
+def merge_batches(state: KVBatch, update: KVBatch, op: str = "sum") -> tuple[KVBatch, jnp.ndarray]:
+    """Merge per-chunk partials into a running distinct-key state.
+
+    Returns (new_state with state's capacity, overflow_count). The merged
+    distinct keys are sorted ascending; if they exceed the state capacity
+    the largest-key tail is dropped and counted in overflow_count (the
+    driver then falls back to host spill — runtime/driver.py).
+    """
+    cap = state.capacity
+    merged = segment_reduce_sorted(sort_kv(concat_batches(state, update)), op=op)
+    overflow = jnp.sum(merged.valid[cap:].astype(jnp.int32))
+    return (
+        KVBatch(merged.k1[:cap], merged.k2[:cap], merged.value[:cap], merged.valid[:cap]),
+        overflow,
+    )

@@ -1,0 +1,86 @@
+"""Hash partitioning into fixed-capacity buckets.
+
+Replaces the reference's file-plane partitioner
+``write_key_value_to_file`` (src/mr/worker.rs:117-140): there each pair is
+routed by ``DefaultHasher(key) % reduce_n`` (worker.rs:111-115,129) into one
+of ``reduce_n`` files with an awaited write per pair. Here routing is
+``k1 % num_buckets`` computed for the whole batch at once, and "files"
+become rows of a ``[num_buckets, capacity]`` device array — the exact
+layout ``lax.all_to_all`` wants for the ICI shuffle (parallel/shuffle.py).
+
+XLA needs static shapes, so each bucket has fixed capacity; records beyond
+a bucket's capacity are dropped and *counted* (the driver sizes capacity
+with a slack factor and watches the overflow counter — SURVEY.md §7 "hard
+parts" (2)).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from mapreduce_rust_tpu.core.hashing import SENTINEL
+from mapreduce_rust_tpu.core.kv import KVBatch
+
+
+@functools.partial(jax.jit, static_argnames=("num_buckets", "capacity"))
+def bucket_scatter(
+    batch: KVBatch, num_buckets: int, capacity: int
+) -> tuple[KVBatch, jnp.ndarray]:
+    """Scatter records into bucket-major layout.
+
+    Returns (KVBatch with arrays shaped [num_buckets, capacity],
+    overflow_count). Invalid records go nowhere; records past a bucket's
+    capacity are dropped into the overflow count.
+    """
+    n = batch.capacity
+    nb = jnp.int32(num_buckets)
+    bucket = jnp.where(
+        batch.valid,
+        (batch.k1 % nb.astype(jnp.uint32)).astype(jnp.int32),
+        jnp.int32(num_buckets),  # invalid → virtual overflow bucket, dropped
+    )
+
+    # Stable sort by bucket so each bucket's records are contiguous.
+    sb, sk1, sk2, sval, svalid = jax.lax.sort(
+        (bucket, batch.k1, batch.k2, batch.value, batch.valid.astype(jnp.int32)),
+        num_keys=1,
+        is_stable=True,
+    )
+    pos = jnp.arange(n, dtype=jnp.int32)
+    # First index of each bucket via segment_min over sorted bucket ids.
+    first = jax.ops.segment_min(pos, sb, num_segments=num_buckets + 1)
+    rank = pos - first[sb]
+
+    keep = (sb < num_buckets) & (rank < capacity) & (svalid > 0)
+    dest = jnp.where(keep, sb * capacity + rank, num_buckets * capacity)
+
+    flat = num_buckets * capacity
+    out_k1 = jnp.full((flat + 1,), SENTINEL, dtype=jnp.uint32).at[dest].set(
+        jnp.where(keep, sk1, jnp.uint32(SENTINEL)), mode="drop"
+    )
+    out_k2 = jnp.full((flat + 1,), SENTINEL, dtype=jnp.uint32).at[dest].set(
+        jnp.where(keep, sk2, jnp.uint32(SENTINEL)), mode="drop"
+    )
+    out_val = jnp.zeros((flat + 1,), dtype=jnp.int32).at[dest].set(
+        jnp.where(keep, sval, 0), mode="drop"
+    )
+    out_valid = jnp.zeros((flat + 1,), dtype=jnp.int32).at[dest].set(
+        jnp.where(keep, 1, 0), mode="drop"
+    )
+
+    n_valid = jnp.sum(batch.valid.astype(jnp.int32))
+    overflow = n_valid - jnp.sum(out_valid[:flat])
+
+    shape = (num_buckets, capacity)
+    return (
+        KVBatch(
+            k1=out_k1[:flat].reshape(shape),
+            k2=out_k2[:flat].reshape(shape),
+            value=out_val[:flat].reshape(shape),
+            valid=out_valid[:flat].reshape(shape).astype(bool),
+        ),
+        overflow,
+    )

@@ -1,0 +1,15 @@
+"""Test env: force JAX onto CPU with 8 virtual devices BEFORE jax imports.
+
+This simulates the v5e-8 mesh on the single-host test machine
+(SURVEY.md §4): shard_map/all_to_all code paths run unchanged; the driver
+separately dry-run-compiles the multi-chip path via __graft_entry__.py.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,124 @@
+"""Sort + segment reduce + partition kernels vs. numpy oracles."""
+
+import collections
+
+import jax.numpy as jnp
+import numpy as np
+
+from mapreduce_rust_tpu.core.hashing import SENTINEL
+from mapreduce_rust_tpu.core.kv import KVBatch
+from mapreduce_rust_tpu.ops.groupby import count_unique, merge_batches, sort_kv
+from mapreduce_rust_tpu.ops.partition import bucket_scatter
+
+
+def make_batch(keys, values, capacity):
+    keys = np.asarray(keys, dtype=np.uint32).reshape(-1, 2)
+    values = np.asarray(values, dtype=np.int32)
+    return KVBatch.from_host(keys, values, capacity)
+
+
+def batch_to_dict(batch: KVBatch) -> dict:
+    keys, vals = batch.to_host()
+    out = {}
+    for (a, b), v in zip(keys.tolist(), vals.tolist()):
+        out[(a, b)] = out.get((a, b), 0) + v
+    return out
+
+
+def test_count_unique_basic():
+    keys = [(1, 1), (2, 2), (1, 1), (3, 3), (1, 1), (2, 2)]
+    batch = make_batch(keys, [1] * 6, capacity=16)
+    out = count_unique(batch)
+    assert batch_to_dict(out) == {(1, 1): 3, (2, 2): 2, (3, 3): 1}
+
+
+def test_count_unique_distinguishes_k2():
+    # Same k1, different k2 must be distinct keys (the 64-bit story).
+    keys = [(7, 1), (7, 2), (7, 1)]
+    batch = make_batch(keys, [1] * 3, capacity=8)
+    assert batch_to_dict(count_unique(batch)) == {(7, 1): 2, (7, 2): 1}
+
+
+def test_count_unique_random_vs_counter():
+    rng = np.random.default_rng(1)
+    n = 4096
+    keys = rng.integers(0, 50, size=(n, 2)).astype(np.uint32)
+    vals = rng.integers(1, 5, size=n).astype(np.int32)
+    batch = make_batch(keys, vals, capacity=n)
+    oracle = collections.defaultdict(int)
+    for (a, b), v in zip(keys.tolist(), vals.tolist()):
+        oracle[(a, b)] += v
+    assert batch_to_dict(count_unique(batch)) == dict(oracle)
+
+
+def test_sorted_output_is_front_packed():
+    keys = [(5, 5), (1, 1), (5, 5)]
+    out = count_unique(make_batch(keys, [1] * 3, capacity=8))
+    valid = np.asarray(out.valid)
+    # valid slots form a prefix
+    first_invalid = valid.argmin() if not valid.all() else len(valid)
+    assert valid[:first_invalid].all() and not valid[first_invalid:].any()
+    assert np.asarray(out.k1)[~valid].tolist() == [SENTINEL] * int((~valid).sum())
+
+
+def test_merge_batches_accumulates():
+    state = KVBatch.empty(8)
+    upd1 = count_unique(make_batch([(1, 1), (2, 2), (1, 1)], [1, 1, 1], 8))
+    state, ovf1 = merge_batches(state, upd1)
+    upd2 = count_unique(make_batch([(2, 2), (3, 3)], [1, 1], 8))
+    state, ovf2 = merge_batches(state, upd2)
+    assert int(ovf1) == 0 and int(ovf2) == 0
+    assert batch_to_dict(state) == {(1, 1): 2, (2, 2): 2, (3, 3): 1}
+
+
+def test_merge_overflow_detected():
+    state = make_batch([(i, i) for i in range(4)], [1] * 4, capacity=4)
+    upd = make_batch([(i + 100, i) for i in range(4)], [1] * 4, capacity=4)
+    state2, ovf = merge_batches(state, upd)
+    assert int(ovf) == 4  # 8 distinct keys into capacity 4
+
+
+def test_bucket_scatter_routes_by_k1_mod():
+    nb, cap = 4, 8
+    keys = [(k1, 7) for k1 in [0, 1, 2, 3, 4, 5, 8, 9]]
+    batch = make_batch(keys, [10 + i for i in range(8)], capacity=16)
+    out, ovf = bucket_scatter(batch, num_buckets=nb, capacity=cap)
+    assert int(ovf) == 0
+    k1 = np.asarray(out.k1)
+    valid = np.asarray(out.valid)
+    for b in range(nb):
+        row_keys = k1[b][valid[b]]
+        assert all(int(k) % nb == b for k in row_keys)
+    # nothing lost
+    assert valid.sum() == 8
+
+
+def test_bucket_scatter_overflow_counted():
+    nb, cap = 2, 2
+    keys = [(0, i) for i in range(6)]  # all to bucket 0, capacity 2
+    batch = make_batch(keys, [1] * 6, capacity=8)
+    out, ovf = bucket_scatter(batch, num_buckets=nb, capacity=cap)
+    assert int(ovf) == 4
+    assert np.asarray(out.valid).sum() == 2
+
+
+def test_bucket_scatter_preserves_totals_random():
+    rng = np.random.default_rng(2)
+    n, nb = 512, 8
+    cap = 2 * n // nb
+    keys = rng.integers(0, 1 << 31, size=(n, 2)).astype(np.uint32)
+    vals = np.ones(n, dtype=np.int32)
+    batch = make_batch(keys, vals, capacity=n)
+    out, ovf = bucket_scatter(batch, num_buckets=nb, capacity=cap)
+    assert int(ovf) == 0
+    oracle = collections.defaultdict(int)
+    for (a, b) in keys.tolist():
+        oracle[(a, b)] += 1
+    got = collections.defaultdict(int)
+    k1 = np.asarray(out.k1).ravel()
+    k2 = np.asarray(out.k2).ravel()
+    vv = np.asarray(out.value).ravel()
+    ok = np.asarray(out.valid).ravel()
+    for a, b, v in zip(k1[ok].tolist(), k2[ok].tolist(), vv[ok].tolist()):
+        got[(a, b)] += v
+    assert got == oracle

@@ -1,0 +1,88 @@
+"""Tokenize+hash kernel vs. pure-host oracle (collections.Counter style)."""
+
+import collections
+import re
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mapreduce_rust_tpu.core.hashing import hash_word, tokenize_host
+from mapreduce_rust_tpu.ops.tokenize import tokenize_and_hash
+
+
+def oracle_counts(text: bytes) -> dict[tuple[int, int], int]:
+    counts: dict[tuple[int, int], int] = collections.defaultdict(int)
+    for w in tokenize_host(text):
+        counts[hash_word(w)] += 1
+    return dict(counts)
+
+
+def device_counts(text: bytes, pad_to: int | None = None) -> dict[tuple[int, int], int]:
+    arr = np.frombuffer(text, dtype=np.uint8)
+    if pad_to:
+        arr = np.concatenate([arr, np.full(pad_to - len(arr), 0x20, np.uint8)])
+    batch = tokenize_and_hash(jnp.asarray(arr))
+    k1 = np.asarray(batch.k1)[np.asarray(batch.valid)]
+    k2 = np.asarray(batch.k2)[np.asarray(batch.valid)]
+    counts: dict[tuple[int, int], int] = collections.defaultdict(int)
+    for a, b in zip(k1.tolist(), k2.tolist()):
+        counts[(a, b)] += 1
+    return dict(counts)
+
+
+def test_host_tokenizer_matches_reference_regex_semantics():
+    # Reference: strip [^\w\s] then split_whitespace (src/app/wc.rs:6-13).
+    text = "Don't stop-me now! it's A_B  c3\n\ttabs"
+    stripped = re.sub(r"[^\w\s]", "", text)
+    expected = [w.encode() for w in stripped.split()]
+    assert tokenize_host(text.encode()) == expected
+
+
+@pytest.mark.parametrize(
+    "text",
+    [
+        b"hello world hello",
+        b"Don't stop-me now! don't",
+        b"  leading and trailing  ",
+        b"one",
+        b"",
+        b"!!! --- ...",  # only punctuation: no tokens
+        b"a! b? a. b, a;",  # punctuation glued to words
+        b"tab\tsep\nnewline\r\ncrlf",
+        b"under_score 123 mix3d _lead trail_",
+        "café naïve résumé café".encode("utf-8"),
+    ],
+)
+def test_device_matches_oracle(text):
+    assert device_counts(text, pad_to=max(64, len(text) + 8)) == oracle_counts(text)
+
+
+def test_punctuation_joins_not_splits():
+    # "don't" and "dont" must be the SAME token (wc.rs regex deletes the ').
+    a = device_counts(b"don't", pad_to=16)
+    b = device_counts(b"dont ", pad_to=16)
+    assert a == b and len(a) == 1
+
+
+def test_case_sensitive():
+    counts = device_counts(b"Word word WORD Word", pad_to=32)
+    assert sorted(counts.values()) == [1, 1, 2]
+
+
+def test_large_random_text():
+    rng = np.random.default_rng(0)
+    vocab = [b"alpha", b"Beta", b"gamma_3", b"don't", b"x"]
+    words = [vocab[i] for i in rng.integers(0, len(vocab), 5000)]
+    text = b" ".join(words) + b"\n"
+    n = 1 << 16
+    assert len(text) < n
+    assert device_counts(text, pad_to=n) == oracle_counts(text)
+
+
+def test_unaligned_last_byte_not_boundary():
+    # last_is_boundary=False: a token touching the chunk edge must NOT emit.
+    arr = jnp.asarray(np.frombuffer(b"hello wor", np.uint8))
+    batch = tokenize_and_hash(arr, last_is_boundary=False)
+    k1 = np.asarray(batch.k1)[np.asarray(batch.valid)]
+    assert len(k1) == 1  # only "hello"; "wor" is cut off
