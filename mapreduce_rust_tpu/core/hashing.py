@@ -3,8 +3,8 @@
 Keys on the TPU data plane are a pair of independent 32-bit polynomial
 hashes (an effective 64-bit key — TPUs have no fast native 64-bit integer
 path, so we keep two uint32 lanes instead). The host dictionary
-(`runtime/dictionary.py`, `native/loader.cpp`) computes the *same* pair so
-hash→word join at egress is exact.
+(`runtime/dictionary.py`; native fast path `native/loader.cpp`, planned)
+computes the *same* pair so hash→word join at egress is exact.
 
 This replaces the reference's `std::collections::hash_map::DefaultHasher`
 keyed on the word string (src/mr/worker.rs:111-115): there the hash only
@@ -40,7 +40,10 @@ H2_INIT = np.uint32(0x9E3779B9)  # golden ratio
 # harmless: padding contributes count 0 to the merged segment.
 SENTINEL = np.uint32(0xFFFFFFFF)
 
-_WHITESPACE = b" \t\n\r\x0b\x0c"
+# The ASCII whitespace byte class — single source of truth, consumed by the
+# device byte-class table below and the host chunker's cut logic.
+WHITESPACE_BYTES = b" \t\n\r\x0b\x0c"
+_WHITESPACE = WHITESPACE_BYTES
 
 
 @functools.lru_cache(maxsize=None)

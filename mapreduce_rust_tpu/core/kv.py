@@ -12,7 +12,8 @@ universal intermediate record on TPU is a padded struct of arrays:
 The reference's KeyValue deliberately does *not* derive Serialize
 (src/lib.rs:9) — pairs can never cross the RPC plane and move only through
 files. The same invariant holds here: KVBatch never crosses the control
-plane; it moves between chips only via ICI collectives (parallel/shuffle.py).
+plane; it moves between chips only via ICI collectives (parallel/shuffle.py,
+planned).
 """
 
 from __future__ import annotations

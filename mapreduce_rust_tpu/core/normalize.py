@@ -1,0 +1,94 @@
+"""Host-side unicode normalization for ingest.
+
+The reference word-count app strips ``[^\\w\\s]`` with a Unicode-aware Rust
+regex and splits on Unicode whitespace (src/app/wc.rs:6-13 via regex 1.9,
+``split_whitespace``). The device kernel (ops/tokenize.py) classifies raw
+*bytes* and treats every byte >= 0x80 as a word char, which is correct for
+UTF-8 letters but wrong for non-ASCII punctuation ("don’t" must hash as
+"dont", an em dash must vanish) and non-ASCII whitespace (U+00A0 must split
+words).
+
+This pass runs once on the host before bytes reach the chunker: every
+non-ASCII codepoint is classified with Python's unicode-aware ``re`` (the
+same UTS#18 word definition Rust's regex crate uses) —
+
+- word chars (``\\w``: letters, digits, marks, underscore) are kept verbatim,
+  so their UTF-8 bytes still read as word chars on device;
+- whitespace becomes an ASCII space (token boundary);
+- everything else is deleted in place, which — exactly like the reference's
+  regex strip — does NOT split the surrounding token.
+
+After normalization the byte stream contains non-ASCII bytes only inside
+genuine words, so the device byte-class tables are exact.
+
+ASCII bytes are never touched here; the device tables already match the
+reference for ASCII (tests/test_tokenize.py).
+
+Known divergence (accepted): Python's ``re`` word class excludes combining
+marks (``\\p{M}``) while Rust's regex crate (UTS#18) includes them, so
+e.g. U+0338 inside a word is deleted here but kept by the reference —
+2 occurrences in the whole 4.11 MB reference corpus. Invalid UTF-8 decodes
+to U+FFFD which is non-word and is deleted (the reference's
+``read_to_string`` would instead fail the task).
+"""
+
+from __future__ import annotations
+
+import functools
+import re
+
+import numpy as np
+
+_WORD_RE = re.compile(r"\w", re.UNICODE)
+
+
+@functools.lru_cache(maxsize=4096)
+def _classify(cp: int) -> int | None:
+    """Translation entry for one non-ASCII codepoint.
+
+    None   -> keep (word char)
+    0x20   -> replace with space (whitespace)
+    -1     -> delete (punctuation/symbol), encoded as '' for str.translate
+    """
+    ch = chr(cp)
+    if _WORD_RE.match(ch):
+        return None
+    if ch.isspace():
+        return 0x20
+    return -1
+
+
+def normalize_unicode(data: bytes) -> bytes:
+    """Normalize a UTF-8 byte string for the device tokenizer.
+
+    Pure-ASCII input is returned unchanged (fast path). Otherwise the text
+    is decoded, every distinct non-ASCII codepoint is classified once, and a
+    C-speed ``str.translate`` applies keep/space/delete in one pass.
+    """
+    if data.isascii():
+        return data
+    text = data.decode("utf-8", errors="replace")
+    # Unique codepoints via the fixed-width UTF-32 view (C speed).
+    cps = np.unique(np.frombuffer(text.encode("utf-32-le"), dtype=np.uint32))
+    table: dict[int, int | str | None] = {}
+    for cp in cps[cps >= 0x80].tolist():
+        cls = _classify(cp)
+        if cls is not None:
+            table[cp] = "" if cls == -1 else " "
+    if not table:
+        return data
+    return text.translate(table).encode("utf-8")
+
+
+def reference_word_counts(data: bytes):
+    """The golden oracle: word -> count with the reference's exact semantics.
+
+    Mirrors src/app/wc.rs:6-13 — delete ``[^\\w\\s]`` (unicode-aware, no
+    token split), then split on unicode whitespace; case-sensitive. Used by
+    end-to-end tests; never by the production path.
+    """
+    from collections import Counter
+
+    text = data.decode("utf-8", errors="replace")
+    cleaned = re.sub(r"[^\w\s]", "", text, flags=re.UNICODE)
+    return Counter(cleaned.split())

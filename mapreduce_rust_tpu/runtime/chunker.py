@@ -1,0 +1,155 @@
+"""Host-side ingest: files → fixed-shape, whitespace-aligned byte chunks.
+
+Replaces the reference's ``read_file_to_mem_map`` (src/mr/worker.rs:65-77),
+which slurps one whole input file into a single ``String`` — its de-facto
+sequence-length ceiling. Here each file is normalized (core/normalize.py)
+and streamed as fixed-size uint8 chunks:
+
+- every chunk is exactly ``chunk_bytes`` long (space-padded), so the device
+  kernels compile once and are reused for the whole corpus;
+- chunks are cut at whitespace boundaries, so no token ever straddles a
+  chunk edge and per-chunk counts sum exactly to whole-corpus counts
+  (the reference gets the same guarantee trivially: one file = one task,
+  src/mr/worker.rs:67). The one exception — a single token longer than
+  ``chunk_bytes`` — is force-split and *counted* in ``Chunk.forced_cut``,
+  like every other lossy path in this codebase (merge/bucket overflow);
+- normalization and chunking run over a bounded sliding window, so peak
+  host memory is O(window), not O(file);
+- a chunk belongs to exactly one document (doc_id = input file index),
+  which is what apps/inverted_index.py (planned) needs.
+
+The pure-device alternative for sharded byte streams (cut anywhere, fix up
+boundary tokens with a ppermute halo) lives in parallel/halo.py (planned).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Iterator, Sequence
+
+import numpy as np
+
+from mapreduce_rust_tpu.core.hashing import WHITESPACE_BYTES
+from mapreduce_rust_tpu.core.normalize import normalize_unicode
+
+_ASCII_WS = frozenset(WHITESPACE_BYTES)
+
+
+@dataclasses.dataclass(frozen=True)
+class Chunk:
+    """One device-ready chunk: uint8[chunk_bytes], space padded."""
+
+    doc_id: int  # input file index
+    seq: int  # chunk index within the document
+    data: np.ndarray  # uint8[chunk_bytes]
+    nbytes: int  # real payload length before padding
+    forced_cut: bool = False  # True: chunk END was cut mid-token (token > chunk_bytes)
+
+
+def _ws_cut(data: bytes, start: int, end: int) -> tuple[int, bool]:
+    """Largest cut <= end with data[cut-1] whitespace; (end, True) if none."""
+    cut = end
+    while cut > start and data[cut - 1] not in _ASCII_WS:
+        cut -= 1
+    if cut == start:
+        return end, True
+    return cut, False
+
+
+def split_points(data: bytes, chunk_bytes: int) -> list[tuple[int, int, bool]]:
+    """(start, end, forced) payload spans, each <= chunk_bytes.
+
+    The cut is placed after the last whitespace byte in the window so the
+    trailing partial token moves whole into the next chunk; ``forced`` marks
+    mid-token cuts (token longer than chunk_bytes — the fragments count as
+    separate words and the caller must surface the event).
+    """
+    spans = []
+    n = len(data)
+    start = 0
+    while start < n:
+        end = min(start + chunk_bytes, n)
+        forced = False
+        if end < n:
+            end, forced = _ws_cut(data, start, end)
+        spans.append((start, end, forced))
+        start = end
+    return spans
+
+
+def _emit(data: bytes, start: int, end: int, forced: bool, doc_id: int, seq: int, chunk_bytes: int) -> Chunk:
+    buf = np.full(chunk_bytes, 0x20, dtype=np.uint8)
+    buf[: end - start] = np.frombuffer(data[start:end], dtype=np.uint8)
+    return Chunk(doc_id=doc_id, seq=seq, data=buf, nbytes=end - start, forced_cut=forced)
+
+
+def chunk_document(
+    raw: bytes,
+    doc_id: int,
+    chunk_bytes: int,
+    normalize: bool = True,
+    window_bytes: int | None = None,
+) -> Iterator[Chunk]:
+    """Stream one document as chunks, normalizing a bounded window at a time.
+
+    The raw stream is first cut into ~window_bytes pieces at ASCII
+    whitespace — safe before normalization because normalize_unicode never
+    alters ASCII bytes, so an ASCII-whitespace cut is a token boundary in
+    both the raw and normalized streams. Each window is normalized
+    independently (normalization never grows a UTF-8 stream: it deletes or
+    maps to single spaces) and the trailing partial chunk is carried into
+    the next window, so emitted chunks are identical to whole-file
+    processing while peak memory stays O(window).
+    """
+    window = window_bytes or max(chunk_bytes * 8, 1 << 24)
+    seq = 0
+    pending = b""
+    pos = 0
+    n = len(raw)
+    while pos < n:
+        wend = min(pos + window, n)
+        if wend < n:
+            wend, forced_window = _ws_cut(raw, pos, wend)
+            if forced_window:
+                # No whitespace in the whole window: cut anyway, but at a
+                # UTF-8 sequence boundary so per-window normalization matches
+                # whole-file normalization byte for byte.
+                while wend > pos + 1 and (raw[wend] & 0xC0) == 0x80:
+                    wend -= 1
+        data = pending + normalize_unicode(raw[pos:wend])
+        pos = wend
+        at_eof = pos >= n
+        spans = split_points(data, chunk_bytes)
+        if not at_eof and spans:
+            # The last span's cut decision isn't final until the following
+            # bytes are known — carry it into the next window. Emitted chunks
+            # are then identical to whole-file processing.
+            *spans, last = spans
+            pending = data[last[0] :]
+        else:
+            pending = b""
+        for start, end, forced in spans:
+            yield _emit(data, start, end, forced, doc_id, seq, chunk_bytes)
+            seq += 1
+
+
+def iter_chunks(
+    paths: Sequence[str | os.PathLike], chunk_bytes: int
+) -> Iterator[Chunk]:
+    """Stream all input files as chunks, doc_id = position in ``paths``.
+
+    Reads and normalizes incrementally — peak host memory is one window,
+    not the corpus (contrast src/mr/worker.rs:73-76).
+    """
+    for doc_id, path in enumerate(paths):
+        with open(path, "rb") as f:
+            raw = f.read()
+        yield from chunk_document(raw, doc_id, chunk_bytes)
+
+
+def list_inputs(input_dir: str, pattern: str = "*.txt") -> list[str]:
+    """Sorted input file list — the doc_id ordering contract."""
+    import glob
+
+    return sorted(glob.glob(os.path.join(input_dir, pattern)))

@@ -7,7 +7,12 @@ import numpy as np
 
 from mapreduce_rust_tpu.core.hashing import SENTINEL
 from mapreduce_rust_tpu.core.kv import KVBatch
-from mapreduce_rust_tpu.ops.groupby import count_unique, merge_batches, sort_kv
+from mapreduce_rust_tpu.ops.groupby import (
+    count_unique,
+    merge_batches,
+    segment_reduce_sorted,
+    sort_kv,
+)
 from mapreduce_rust_tpu.ops.partition import bucket_scatter
 
 
@@ -49,6 +54,25 @@ def test_count_unique_random_vs_counter():
     for (a, b), v in zip(keys.tolist(), vals.tolist()):
         oracle[(a, b)] += v
     assert batch_to_dict(count_unique(batch)) == dict(oracle)
+
+
+def test_segment_reduce_max_min_vs_oracle():
+    # max/min with negative values and padding: iinfo sentinel masking must
+    # not leak into real segments (ADVICE r1).
+    rng = np.random.default_rng(7)
+    n = 256
+    keys = rng.integers(0, 12, size=(n, 2)).astype(np.uint32)
+    vals = rng.integers(-100, 100, size=n).astype(np.int32)
+    batch = make_batch(keys, vals, capacity=n + 64)  # 64 padding slots
+    for op, fold in (("max", max), ("min", min)):
+        oracle: dict = {}
+        for (a, b), v in zip(keys.tolist(), vals.tolist()):
+            k = (a, b)
+            oracle[k] = fold(oracle[k], v) if k in oracle else v
+        out = segment_reduce_sorted(sort_kv(batch), op=op)
+        keys_out, vals_out = out.to_host()
+        got = {tuple(k): v for k, v in zip(keys_out.tolist(), vals_out.tolist())}
+        assert got == oracle, op
 
 
 def test_sorted_output_is_front_packed():

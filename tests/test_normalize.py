@@ -1,0 +1,77 @@
+"""Unicode normalization: device pipeline must match the reference's
+unicode-aware regex semantics (src/app/wc.rs:6-13) after host ingest
+normalization — including on the real Gutenberg corpus."""
+
+import collections
+import pathlib
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mapreduce_rust_tpu.core.hashing import hash_word
+from mapreduce_rust_tpu.core.normalize import normalize_unicode, reference_word_counts
+from mapreduce_rust_tpu.ops.tokenize import tokenize_and_hash
+
+CORPUS = pathlib.Path("/root/reference/src/data")
+
+
+def device_hash_counts(raw: bytes) -> dict:
+    data = normalize_unicode(raw)
+    n = max(64, 1 << (len(data) + 8).bit_length())
+    arr = np.full(n, 0x20, np.uint8)
+    arr[: len(data)] = np.frombuffer(data, np.uint8)
+    batch = tokenize_and_hash(jnp.asarray(arr))
+    valid = np.asarray(batch.valid)
+    k1 = np.asarray(batch.k1)[valid].tolist()
+    k2 = np.asarray(batch.k2)[valid].tolist()
+    return dict(collections.Counter(zip(k1, k2)))
+
+
+def oracle_hash_counts(raw: bytes) -> dict:
+    return {
+        hash_word(w.encode("utf-8")): c for w, c in reference_word_counts(raw).items()
+    }
+
+
+def test_curly_apostrophe_deleted_not_split():
+    # U+2019: "don’t" → "dont", same key as ASCII "dont" (ADVICE r1 medium).
+    a = device_hash_counts("don’t".encode("utf-8"))
+    b = device_hash_counts(b"dont")
+    assert a == b and len(a) == 1
+
+
+def test_em_dash_produces_no_token():
+    assert device_hash_counts("a — b".encode("utf-8")) == device_hash_counts(b"a b")
+    assert device_hash_counts("—".encode("utf-8")) == {}
+
+
+def test_nbsp_splits_words():
+    # U+00A0 is unicode whitespace: must be a token boundary, not a word char.
+    assert device_hash_counts("one two".encode("utf-8")) == device_hash_counts(
+        b"one two"
+    )
+
+
+def test_curly_quotes_stripped():
+    raw = "“Hello,” she said — ‘really’…".encode("utf-8")
+    assert device_hash_counts(raw) == oracle_hash_counts(raw)
+
+
+def test_accented_letters_kept_distinct():
+    raw = "café cafe café".encode("utf-8")
+    counts = device_hash_counts(raw)
+    assert sorted(counts.values()) == [1, 2]
+    assert counts == oracle_hash_counts(raw)
+
+
+def test_ascii_fast_path_identity():
+    data = b"plain ascii text, nothing to do!"
+    assert normalize_unicode(data) is data
+
+
+@pytest.mark.skipif(not CORPUS.exists(), reason="reference corpus not mounted")
+@pytest.mark.parametrize("name", ["gut-2.txt", "gut-3.txt"])
+def test_real_corpus_matches_reference_oracle(name):
+    raw = (CORPUS / name).read_bytes()
+    assert device_hash_counts(raw) == oracle_hash_counts(raw)
