@@ -1,0 +1,23 @@
+"""App registry — the counterpart of the reference's src/app/mod.rs, but a
+real plugin surface: apps are objects (apps/base.py), selected by name at
+the CLI/driver boundary instead of compile-time-fixed boxed fns
+(src/mr/worker.rs:148,175)."""
+
+from mapreduce_rust_tpu.apps.base import App  # noqa: F401
+from mapreduce_rust_tpu.apps.inverted_index import InvertedIndex  # noqa: F401
+from mapreduce_rust_tpu.apps.top_k import TopK  # noqa: F401
+from mapreduce_rust_tpu.apps.word_count import WordCount  # noqa: F401
+
+REGISTRY: dict[str, type[App]] = {
+    "word_count": WordCount,
+    "inverted_index": InvertedIndex,
+    "top_k": TopK,
+}
+
+
+def get_app(name: str, **kwargs) -> App:
+    try:
+        cls = REGISTRY[name]
+    except KeyError:
+        raise ValueError(f"unknown app {name!r}; have {sorted(REGISTRY)}") from None
+    return cls(**kwargs)

@@ -1,0 +1,70 @@
+"""The app plugin seam — a real one this time.
+
+The reference's UDF indirection takes boxed functions but hard-codes
+``Box::new(wc::map)`` / ``Box::new(wc::reduce)`` at its only call sites
+(src/mr/worker.rs:16-25,148,175), so the app is compile-time-fixed to word
+count. Here an app is a first-class object the driver is parameterized by,
+split along the TPU-natural seams:
+
+- **device_map** — a jit-traceable transform applied on device to the
+  tokenized KVBatch of each chunk (e.g. stamp doc_id as the value). Runs
+  inside the driver's compiled step; must be shape-preserving and pure.
+- **combine_op** — the associative reduce op (ops/groupby.REDUCE_OPS).
+  Associativity is the load-bearing contract: it is what lets per-chunk
+  partials merge on device, spill tails sum on host, and per-chip partials
+  merge across the mesh, all without coordination. (The reference's
+  ``wc::reduce`` = values.len() is associative only by luck and is applied
+  exactly once per key; src/app/wc.rs:15-17.)
+- **finalize** — host-side egress: turns the final (word, value) table into
+  output lines, partitioned by ``hash(key) % reduce_n`` like the reference's
+  mr-{r}.txt split (src/mr/worker.rs:121,129,167).
+
+Apps register by name in ``apps.REGISTRY`` (apps/__init__.py), the
+counterpart of the reference's one-line module registry (src/app/mod.rs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable
+
+import jax.numpy as jnp
+
+from mapreduce_rust_tpu.core.kv import KVBatch
+
+#: finalize receives, per key: int value for scalar ops ("sum"/"max"/"min"),
+#: or a sorted list[int] of distinct values for "distinct".
+FinalValue = "int | list[int]"
+
+
+@dataclasses.dataclass(frozen=True)
+class App:
+    """Base app: identity device_map, sum combiner, 'word value' egress."""
+
+    name: str = "app"
+    combine_op: str = "sum"
+
+    def device_map(self, kv: KVBatch, doc_id: jnp.ndarray) -> KVBatch:
+        """On-device per-chunk transform; doc_id is a traced int32 scalar."""
+        return kv
+
+    def finalize(
+        self, items: Iterable[tuple[bytes, "FinalValue", tuple[int, int]]], reduce_n: int
+    ) -> dict[int, list[bytes]]:
+        """items: (word, value, key_pair) for every distinct key, unordered.
+
+        Returns partition → output lines (no trailing newline). Default:
+        route by k1 % reduce_n — the reference's partitioner
+        (src/mr/worker.rs:111-115,129) — one 'word value' line per key,
+        sorted bytewise within each partition like the reference's
+        sort-then-emit reduce (src/mr/worker.rs:162-184).
+        """
+        parts: dict[int, list[bytes]] = {r: [] for r in range(reduce_n)}
+        for word, value, (k1, _k2) in items:
+            parts[k1 % reduce_n].append(self.format_line(word, value))
+        for lines in parts.values():
+            lines.sort()
+        return parts
+
+    def format_line(self, word: bytes, value: "FinalValue") -> bytes:
+        return b"%s %d" % (word, value)

@@ -1,0 +1,40 @@
+"""Inverted index: term → sorted list of doc_ids containing it.
+
+No reference counterpart exists (the reference ships only word count,
+src/app/mod.rs); this is BASELINE.json config 4. The TPU formulation:
+
+- device_map stamps the chunk's doc_id as every record's value, so the
+  stream becomes (term-hash, doc_id) pairs;
+- combine_op "distinct" makes the value part of the sort key
+  (ops/groupby.py): duplicates of (term, doc) collapse on device, and the
+  posting *set* builds associatively across chunks and chips — no
+  variable-length lists ever exist in device memory;
+- finalize groups the surviving (term, doc) pairs by term on the host and
+  emits 'word d0,d1,...' with doc_ids ascending.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from mapreduce_rust_tpu.apps.base import App
+from mapreduce_rust_tpu.core.kv import KVBatch
+
+
+@dataclasses.dataclass(frozen=True)
+class InvertedIndex(App):
+    name: str = "inverted_index"
+    combine_op: str = "distinct"
+
+    def device_map(self, kv: KVBatch, doc_id: jnp.ndarray) -> KVBatch:
+        return KVBatch(
+            k1=kv.k1,
+            k2=kv.k2,
+            value=jnp.where(kv.valid, doc_id.astype(jnp.int32), 0),
+            valid=kv.valid,
+        )
+
+    def format_line(self, word: bytes, value) -> bytes:
+        return b"%s %s" % (word, ",".join(str(d) for d in value).encode())

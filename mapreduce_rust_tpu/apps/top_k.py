@@ -1,0 +1,37 @@
+"""Top-k most frequent words (BASELINE.json config 5).
+
+Counting is word count; selection happens at egress. The map/combine path
+is identical to WordCount (sum combiner), so the device does all the heavy
+lifting; finalize keeps only the k most frequent words. Output goes to
+partition 0 — a global top-k is one list, not reduce_n hash partitions.
+Ties break bytewise on the word so output is deterministic at any reduce_n
+or mesh shape (SURVEY.md §4 determinism test).
+
+In the mesh path the per-chip partial counts merge over ICI before
+finalize sees them (parallel/shuffle.py), which is the 'combiner +
+tree-reduce' shape BASELINE.json names: per-chip counting, one global
+selection.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+from typing import Iterable
+
+from mapreduce_rust_tpu.apps.base import App
+
+
+@dataclasses.dataclass(frozen=True)
+class TopK(App):
+    name: str = "top_k"
+    combine_op: str = "sum"
+    k: int = 20
+
+    def finalize(
+        self, items: Iterable[tuple[bytes, int, tuple[int, int]]], reduce_n: int
+    ) -> dict[int, list[bytes]]:
+        top = heapq.nsmallest(self.k, items, key=lambda it: (-it[1], it[0]))
+        parts: dict[int, list[bytes]] = {r: [] for r in range(reduce_n)}
+        parts[0] = [self.format_line(w, v) for w, v, _ in top]
+        return parts

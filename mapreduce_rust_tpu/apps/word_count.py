@@ -1,0 +1,22 @@
+"""Word count — the canonical app (reference: src/app/wc.rs).
+
+map: tokenize+hash already emits (word-hash, 1) per occurrence
+(ops/tokenize.py), so device_map is the identity. combine: sum — equivalent
+to the reference's ``reduce = values.len()`` (src/app/wc.rs:15-17) because
+every emitted value is 1, but associative, so partial counts merge across
+chunks/chips. Egress: 'word count' lines, the reference's output format
+(src/mr/worker.rs:180-183) — including the last key of every partition,
+which the reference silently drops (worker.rs:169-184).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from mapreduce_rust_tpu.apps.base import App
+
+
+@dataclasses.dataclass(frozen=True)
+class WordCount(App):
+    name: str = "word_count"
+    combine_op: str = "sum"

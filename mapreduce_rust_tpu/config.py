@@ -26,6 +26,9 @@ class Config:
     chunk_bytes: int = 1 << 22      # bytes per map chunk fed to the device
     max_word_len: int = 64          # device tokenizer halo / truncation cap
     merge_capacity: int = 1 << 21   # running distinct-key capacity on device
+    partial_capacity: Optional[int] = None  # per-chunk distinct-key cap
+                                    # (None → chunk_bytes // 8; overflow
+                                    # replays the chunk full-width, exact)
     bucket_capacity_factor: float = 2.0  # all_to_all per-bucket slack
     device: str = "auto"            # "auto" | "tpu" | "cpu"
     mesh_shape: Optional[int] = None  # devices in the 1-D mesh (None = all)

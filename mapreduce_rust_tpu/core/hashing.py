@@ -77,10 +77,43 @@ def hash_word(word: bytes) -> tuple[int, int]:
 
 
 def hash_words(words: list[bytes]) -> np.ndarray:
-    """Vectorized host hash of many words → uint32 array [n, 2]."""
-    out = np.empty((len(words), 2), dtype=np.uint32)
-    for i, w in enumerate(words):
-        out[i] = hash_word(w)
+    """Vectorized host hash of many words → uint32 array [n, 2].
+
+    Column-wise over a padded [n, maxlen] byte matrix: maxlen vectorized
+    numpy steps instead of sum(len) Python steps. Exactly equals
+    ``hash_word`` per row (tests/test_tokenize.py); the C fast path lives in
+    native/loader.cpp (see native/host.py).
+    """
+    n = len(words)
+    out = np.empty((n, 2), dtype=np.uint32)
+    if n == 0:
+        return out
+    lens = np.fromiter((len(w) for w in words), dtype=np.int64, count=n)
+    # Batches are length-sorted so each group's matrix is sized by its OWN
+    # longest word — one pathological multi-MB token (a force-cut fragment
+    # of whitespace-free input) costs only its own group, never
+    # n × maxlen memory.
+    order = np.argsort(lens, kind="stable")
+    group = 4096
+    for g0 in range(0, n, group):
+        idx = order[g0 : g0 + group]
+        glens = lens[idx]
+        gmax = int(glens.max())
+        mat = np.zeros((len(idx), max(gmax, 1)), dtype=np.uint8)
+        for row, i in enumerate(idx.tolist()):
+            w = words[i]
+            if w:
+                mat[row, : len(w)] = np.frombuffer(w, dtype=np.uint8)
+        h1 = np.full(len(idx), H1_INIT, dtype=np.uint32)
+        h2 = np.full(len(idx), H2_INIT, dtype=np.uint32)
+        with np.errstate(over="ignore"):
+            for j in range(gmax):
+                live = glens > j
+                c1 = mat[:, j].astype(np.uint32) + np.uint32(1)
+                h1 = np.where(live, h1 * H1_MULT + c1, h1)
+                h2 = np.where(live, h2 * H2_MULT + c1, h2)
+        out[idx, 0] = h1
+        out[idx, 1] = h2
     return out
 
 

@@ -9,6 +9,16 @@ not reproduce). Here the same shape is ``lax.sort`` on the hash-pair key
 ``jax.ops.segment_sum`` — every group flushed, including the last, by
 construction.
 
+Reduce ops (the associative-combiner contract every app must satisfy):
+
+- ``"sum"``  — word count: total occurrences per key.
+- ``"max"`` / ``"min"`` — extremal value per key.
+- ``"distinct"`` — the value joins the sort key; one output record per
+  distinct (key, value) pair. This is how inverted_index represents
+  doc-id posting sets on device: dedup is associative, so per-chunk
+  distinct sets merge into a global distinct set exactly like partial
+  counts merge into totals.
+
 All functions keep static shapes: outputs are padded to the input capacity
 with SENTINEL keys so they stay jit/shard_map-friendly.
 """
@@ -21,12 +31,22 @@ import jax.numpy as jnp
 from mapreduce_rust_tpu.core.hashing import SENTINEL
 from mapreduce_rust_tpu.core.kv import KVBatch
 
+#: Ops whose combiner is idempotent per (key, value) — the value is part of
+#: the sort key and duplicates collapse to one record.
+_VALUE_KEYED_OPS = frozenset({"distinct"})
+REDUCE_OPS = frozenset({"sum", "max", "min", "distinct"})
 
-def sort_kv(batch: KVBatch) -> KVBatch:
-    """Sort records by (k1, k2). SENTINEL-keyed padding sorts to the end."""
+
+def sort_kv(batch: KVBatch, by_value: bool = False) -> KVBatch:
+    """Sort records by (k1, k2) — or (k1, k2, value) when ``by_value``.
+
+    SENTINEL-keyed padding sorts to the end either way (SENTINEL is the max
+    uint32, so padding keys dominate the comparison before value is reached).
+    """
+    num_keys = 3 if by_value else 2
     k1, k2, value, valid = jax.lax.sort(
         (batch.k1, batch.k2, batch.value, batch.valid.astype(jnp.int32)),
-        num_keys=2,
+        num_keys=num_keys,
         is_stable=True,
     )
     return KVBatch(k1, k2, value, valid.astype(bool))
@@ -35,15 +55,23 @@ def sort_kv(batch: KVBatch) -> KVBatch:
 def segment_reduce_sorted(batch: KVBatch, op: str = "sum") -> KVBatch:
     """Reduce a key-sorted batch: one output record per distinct key.
 
-    op: "sum" (word count totals), "max", or "min" over values.
+    op: "sum" (word count totals), "max"/"min" over values, or "distinct"
+    (batch must be sorted with ``by_value=True``; one record per distinct
+    (key, value) pair, value preserved).
+
     Output is padded to the same capacity; slot i holds the i-th distinct
     key (sorted ascending), so real records sit at the front.
     """
+    if op not in REDUCE_OPS:
+        raise ValueError(f"unknown reduce op: {op}")
     n = batch.capacity
     prev_k1 = jnp.concatenate([batch.k1[:1], batch.k1[:-1]])
     prev_k2 = jnp.concatenate([batch.k2[:1], batch.k2[:-1]])
     first = jnp.arange(n) == 0
     boundary = first | (batch.k1 != prev_k1) | (batch.k2 != prev_k2)
+    if op in _VALUE_KEYED_OPS:
+        prev_val = jnp.concatenate([batch.value[:1], batch.value[:-1]])
+        boundary = boundary | (batch.value != prev_val)
     # Padding (SENTINEL,SENTINEL) forms at most one trailing segment.
     seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1
 
@@ -56,8 +84,9 @@ def segment_reduce_sorted(batch: KVBatch, op: str = "sum") -> KVBatch:
     elif op == "min":
         small = jnp.where(batch.valid, batch.value, jnp.iinfo(jnp.int32).max)
         totals = jax.ops.segment_min(small, seg, num_segments=n)
-    else:
-        raise ValueError(f"unknown reduce op: {op}")
+    else:  # distinct: every record in the segment shares one value
+        big = jnp.where(boundary, batch.value, jnp.iinfo(jnp.int32).min)
+        totals = jax.ops.segment_max(big, seg, num_segments=n)
 
     live = jax.ops.segment_sum(batch.valid.astype(jnp.int32), seg, num_segments=n)
     uk1 = jax.ops.segment_max(jnp.where(boundary, batch.k1, 0), seg, num_segments=n)
@@ -75,10 +104,12 @@ def segment_reduce_sorted(batch: KVBatch, op: str = "sum") -> KVBatch:
     )
 
 
-def count_unique(batch: KVBatch) -> KVBatch:
-    """Sort + sum-reduce: (distinct keys, summed values). The map-side
-    combiner (word count's reduce is associative, so partial counts merge)."""
-    return segment_reduce_sorted(sort_kv(batch), op="sum")
+def count_unique(batch: KVBatch, op: str = "sum") -> KVBatch:
+    """Sort + reduce: (distinct keys, combined values). The map-side
+    combiner — every app's combine op is associative, so per-chunk partials
+    merge exactly (word count: partial sums; inverted_index: distinct
+    (term, doc) pairs)."""
+    return segment_reduce_sorted(sort_kv(batch, by_value=op in _VALUE_KEYED_OPS), op=op)
 
 
 def concat_batches(a: KVBatch, b: KVBatch) -> KVBatch:
@@ -90,18 +121,26 @@ def concat_batches(a: KVBatch, b: KVBatch) -> KVBatch:
     )
 
 
-def merge_batches(state: KVBatch, update: KVBatch, op: str = "sum") -> tuple[KVBatch, jnp.ndarray]:
+def merge_batches(
+    state: KVBatch, update: KVBatch, op: str = "sum"
+) -> tuple[KVBatch, KVBatch]:
     """Merge per-chunk partials into a running distinct-key state.
 
-    Returns (new_state with state's capacity, overflow_count). The merged
-    distinct keys are sorted ascending; if they exceed the state capacity
-    the largest-key tail is dropped and counted in overflow_count (the
-    driver then falls back to host spill — runtime/driver.py).
+    Returns ``(new_state, evicted)``. ``new_state`` keeps the smallest
+    ``state.capacity`` distinct keys (sorted ascending); any overflow — the
+    largest-key tail of the merge — is returned whole as ``evicted``
+    (capacity = ``update.capacity``), NOT dropped: its records carry their
+    full merged values, and the driver spills them to the host accumulator
+    (runtime/driver.py). For scalar ops a key never appears in both halves,
+    so summing state + spills on the host reconstructs exact totals. For
+    value-keyed ops ("distinct") the cut can land mid-key — (k,v1) kept,
+    (k,v2) evicted — so hosts must fold spills by set-union per key, never
+    treat an evicted key as final (HostAccumulator does this).
     """
     cap = state.capacity
-    merged = segment_reduce_sorted(sort_kv(concat_batches(state, update)), op=op)
-    overflow = jnp.sum(merged.valid[cap:].astype(jnp.int32))
-    return (
-        KVBatch(merged.k1[:cap], merged.k2[:cap], merged.value[:cap], merged.valid[:cap]),
-        overflow,
+    merged = segment_reduce_sorted(
+        sort_kv(concat_batches(state, update), by_value=op in _VALUE_KEYED_OPS), op=op
     )
+    head = KVBatch(merged.k1[:cap], merged.k2[:cap], merged.value[:cap], merged.valid[:cap])
+    evicted = KVBatch(merged.k1[cap:], merged.k2[cap:], merged.value[cap:], merged.valid[cap:])
+    return head, evicted

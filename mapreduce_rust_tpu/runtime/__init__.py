@@ -1,0 +1,6 @@
+"""Runtime tier: host-side ingest, streaming driver, dictionary, metrics."""
+
+from mapreduce_rust_tpu.runtime.chunker import Chunk, chunk_document, chunk_stream, iter_chunks, list_inputs  # noqa: F401
+from mapreduce_rust_tpu.runtime.dictionary import Dictionary, extract_words  # noqa: F401
+from mapreduce_rust_tpu.runtime.driver import JobResult, merge_outputs, run_job  # noqa: F401
+from mapreduce_rust_tpu.runtime.metrics import JobStats  # noqa: F401

@@ -84,6 +84,64 @@ def _emit(data: bytes, start: int, end: int, forced: bool, doc_id: int, seq: int
     return Chunk(doc_id=doc_id, seq=seq, data=buf, nbytes=end - start, forced_cut=forced)
 
 
+def chunk_stream(
+    f,
+    doc_id: int,
+    chunk_bytes: int,
+    normalize: bool = True,
+    window_bytes: int | None = None,
+) -> Iterator[Chunk]:
+    """Stream one document from a binary file object, one window at a time.
+
+    Each ~window_bytes read is cut at ASCII whitespace — safe before
+    normalization because normalize_unicode never alters ASCII bytes, so an
+    ASCII-whitespace cut is a token boundary in both the raw and normalized
+    streams. The raw tail past the cut carries into the next read, and the
+    trailing partial *chunk* carries likewise, so emitted chunks are
+    identical to whole-file processing while peak host memory is O(window)
+    — never O(file), contrast src/mr/worker.rs:73-76.
+
+    normalize=False skips unicode normalization (raw byte passthrough for
+    ASCII-only or pre-normalized input).
+    """
+    window = window_bytes or max(chunk_bytes * 8, 1 << 24)
+    seq = 0
+    pending = b""    # normalized bytes whose chunk cut isn't final yet
+    raw_carry = b""  # raw bytes past the window's whitespace cut
+    while True:
+        piece = f.read(window)
+        at_eof = not piece
+        buf = raw_carry + piece
+        raw_carry = b""
+        if not at_eof and buf:
+            cut, forced_window = _ws_cut(buf, 0, len(buf))
+            if forced_window:
+                # No whitespace in the whole window: cut anyway, but at a
+                # UTF-8 sequence boundary so per-window normalization
+                # matches whole-file normalization byte for byte. Back off
+                # past any trailing continuation bytes and their lead byte —
+                # a complete trailing sequence also moves whole into carry.
+                while cut > 1 and (buf[cut - 1] & 0xC0) == 0x80:
+                    cut -= 1
+                if cut > 1 and buf[cut - 1] >= 0xC0:
+                    cut -= 1
+            raw_carry = buf[cut:]
+            buf = buf[:cut]
+        data = pending + (normalize_unicode(buf) if normalize else buf)
+        pending = b""
+        spans = split_points(data, chunk_bytes)
+        if not at_eof and spans:
+            # The last span's cut decision isn't final until the following
+            # bytes are known — carry it into the next window.
+            *spans, last = spans
+            pending = data[last[0] :]
+        for start, end, forced in spans:
+            yield _emit(data, start, end, forced, doc_id, seq, chunk_bytes)
+            seq += 1
+        if at_eof:
+            return
+
+
 def chunk_document(
     raw: bytes,
     doc_id: int,
@@ -91,47 +149,10 @@ def chunk_document(
     normalize: bool = True,
     window_bytes: int | None = None,
 ) -> Iterator[Chunk]:
-    """Stream one document as chunks, normalizing a bounded window at a time.
+    """chunk_stream over an in-memory document."""
+    import io
 
-    The raw stream is first cut into ~window_bytes pieces at ASCII
-    whitespace — safe before normalization because normalize_unicode never
-    alters ASCII bytes, so an ASCII-whitespace cut is a token boundary in
-    both the raw and normalized streams. Each window is normalized
-    independently (normalization never grows a UTF-8 stream: it deletes or
-    maps to single spaces) and the trailing partial chunk is carried into
-    the next window, so emitted chunks are identical to whole-file
-    processing while peak memory stays O(window).
-    """
-    window = window_bytes or max(chunk_bytes * 8, 1 << 24)
-    seq = 0
-    pending = b""
-    pos = 0
-    n = len(raw)
-    while pos < n:
-        wend = min(pos + window, n)
-        if wend < n:
-            wend, forced_window = _ws_cut(raw, pos, wend)
-            if forced_window:
-                # No whitespace in the whole window: cut anyway, but at a
-                # UTF-8 sequence boundary so per-window normalization matches
-                # whole-file normalization byte for byte.
-                while wend > pos + 1 and (raw[wend] & 0xC0) == 0x80:
-                    wend -= 1
-        data = pending + normalize_unicode(raw[pos:wend])
-        pos = wend
-        at_eof = pos >= n
-        spans = split_points(data, chunk_bytes)
-        if not at_eof and spans:
-            # The last span's cut decision isn't final until the following
-            # bytes are known — carry it into the next window. Emitted chunks
-            # are then identical to whole-file processing.
-            *spans, last = spans
-            pending = data[last[0] :]
-        else:
-            pending = b""
-        for start, end, forced in spans:
-            yield _emit(data, start, end, forced, doc_id, seq, chunk_bytes)
-            seq += 1
+    yield from chunk_stream(io.BytesIO(raw), doc_id, chunk_bytes, normalize, window_bytes)
 
 
 def iter_chunks(
@@ -144,8 +165,7 @@ def iter_chunks(
     """
     for doc_id, path in enumerate(paths):
         with open(path, "rb") as f:
-            raw = f.read()
-        yield from chunk_document(raw, doc_id, chunk_bytes)
+            yield from chunk_stream(f, doc_id, chunk_bytes)
 
 
 def list_inputs(input_dir: str, pattern: str = "*.txt") -> list[str]:

@@ -1,0 +1,127 @@
+"""Host-side hash→word dictionary — the egress join table.
+
+The TPU data plane computes on 64-bit hash pairs only; word bytes never
+cross the interconnect (core/hashing.py). The reference instead shuffles the
+strings themselves through `mr-{m}-{r}.txt` files and emits them verbatim at
+reduce time (src/mr/worker.rs:180-183). To print real words at egress we
+build this dictionary on the host *during ingest*: every chunk's distinct
+words are hashed with the same pair of polynomial lanes the device uses, so
+`hash pair → word` lookup at egress is exact.
+
+Hash-collision policy (SURVEY.md §7 hard part 3): inserts that map a *new*
+word onto an *existing* pair are detected here — the one place collisions
+are observable — counted, and the first word wins (a collision would also
+merge the two words' counts on device; at ~2^64 pair space and <10^7 word
+vocabularies the birthday bound makes this astronomically unlikely, but it
+is checked, not assumed).
+
+Word extraction is C-speed: ASCII punctuation is deleted with
+``bytes.translate`` and tokens split on ASCII whitespace — valid only on
+*normalized* bytes (core/normalize.py guarantees non-ASCII bytes occur only
+inside genuine words), where it exactly matches the device tokenizer.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, Iterator
+
+import numpy as np
+
+from mapreduce_rust_tpu.core.hashing import byte_class_tables, hash_words
+
+
+def _delete_table() -> bytes:
+    """ASCII bytes that are neither whitespace nor word chars — deleted by
+    tokenization without splitting the token (the reference's ``[^\\w\\s]``
+    strip, src/app/wc.rs:7-8)."""
+    ws, wc = byte_class_tables()
+    return bytes(b for b in range(0x80) if not ws[b] and not wc[b])
+
+_DELETE = _delete_table()
+
+
+def extract_words(normalized: bytes) -> list[bytes]:
+    """Cleaned words of a normalized byte chunk, in order, duplicates kept.
+
+    Identical semantics to core/hashing.tokenize_host (the per-byte oracle)
+    but via two C-level passes; pure-punctuation tokens vanish because they
+    translate to b"" and split() drops empties.
+    """
+    return normalized.translate(None, _DELETE).split()
+
+
+class Dictionary:
+    """hash pair → word bytes, built incrementally at ingest."""
+
+    def __init__(self) -> None:
+        self._word_of: dict[tuple[int, int], bytes] = {}
+        self._seen: set[bytes] = set()
+        self.collisions: list[tuple[bytes, bytes]] = []  # (kept, rejected)
+
+    def __len__(self) -> int:
+        return len(self._word_of)
+
+    def __contains__(self, key: tuple[int, int]) -> bool:
+        return key in self._word_of
+
+    def lookup(self, k1: int, k2: int) -> bytes | None:
+        return self._word_of.get((k1, k2))
+
+    def add_words(self, words: Iterable[bytes]) -> int:
+        """Insert unseen words; returns the number of new entries."""
+        fresh: list[bytes] = []
+        seen = self._seen
+        for w in words:
+            if w not in seen:
+                seen.add(w)
+                fresh.append(w)
+        if not fresh:
+            return 0
+        keys = hash_words(fresh)
+        added = 0
+        word_of = self._word_of
+        for (k1, k2), w in zip(keys.tolist(), fresh):
+            key = (k1, k2)
+            prev = word_of.get(key)
+            if prev is None:
+                word_of[key] = w
+                added += 1
+            elif prev != w:
+                self.collisions.append((prev, w))
+        return added
+
+    def add_text(self, normalized: bytes) -> int:
+        return self.add_words(extract_words(normalized))
+
+    def items(self) -> Iterator[tuple[tuple[int, int], bytes]]:
+        return iter(self._word_of.items())
+
+    def merge(self, other: "Dictionary") -> None:
+        for key, w in other._word_of.items():
+            prev = self._word_of.get(key)
+            if prev is None:
+                self._word_of[key] = w
+                self._seen.add(w)
+            elif prev != w:
+                self.collisions.append((prev, w))
+
+    # ---- persistence (the multi-process control-plane path: map tasks
+    # write dictionary shards next to their spilled partials, reduce tasks
+    # merge them — the TPU analog of the reference's mr-{m}-{r}.txt files) --
+
+    def save(self, path: str | os.PathLike) -> None:
+        """Words contain no whitespace bytes, so 'k1 k2 word' lines are safe."""
+        with open(path, "wb") as f:
+            for (k1, k2), w in self._word_of.items():
+                f.write(b"%d %d %s\n" % (k1, k2, w))
+
+    @classmethod
+    def load(cls, path: str | os.PathLike) -> "Dictionary":
+        d = cls()
+        with open(path, "rb") as f:
+            for line in f:
+                a, b, w = line.rstrip(b"\n").split(b" ", 2)
+                d._word_of[(int(a), int(b))] = w
+                d._seen.add(w)
+        return d

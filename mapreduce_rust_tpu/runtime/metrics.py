@@ -1,0 +1,59 @@
+"""Job metrics & phase timing — per-phase, never per-record.
+
+The reference's only observability is ~30 ``println!`` protocol lines plus
+one log line *per emitted KV pair* inside the map hot loop
+(src/mr/worker.rs:131-136) — the most expensive "observability" in the
+system. Here counters accumulate in one dataclass and are logged once per
+phase (driver) or once per task (worker); per-chunk detail is DEBUG level.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from contextlib import contextmanager
+
+log = logging.getLogger("mapreduce_rust_tpu")
+
+
+@dataclasses.dataclass
+class JobStats:
+    bytes_in: int = 0
+    chunks: int = 0
+    forced_cuts: int = 0          # tokens longer than chunk_bytes, split
+    distinct_keys: int = 0        # final distinct key count
+    spill_events: int = 0         # merges whose evicted tail was non-empty
+    spilled_keys: int = 0         # records moved device → host accumulator
+    partial_overflow_replays: int = 0  # chunks re-run on the full-width path
+    dictionary_words: int = 0
+    hash_collisions: int = 0
+    unknown_keys: int = 0         # final keys missing from the dictionary
+    wall_seconds: float = 0.0
+    phase_seconds: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def gb_per_s(self) -> float:
+        return self.bytes_in / self.wall_seconds / 1e9 if self.wall_seconds else 0.0
+
+    @contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.phase_seconds[name] = self.phase_seconds.get(name, 0.0) + dt
+            log.info("phase %-10s %8.3fs", name, dt)
+
+    def summary(self) -> str:
+        phases = " ".join(f"{k}={v:.2f}s" for k, v in self.phase_seconds.items())
+        return (
+            f"{self.bytes_in / 1e6:.2f} MB in {self.wall_seconds:.3f}s "
+            f"({self.gb_per_s:.3f} GB/s) chunks={self.chunks} "
+            f"distinct={self.distinct_keys} dict={self.dictionary_words} "
+            f"spills={self.spill_events}({self.spilled_keys} keys) "
+            f"replays={self.partial_overflow_replays} "
+            f"collisions={self.hash_collisions} unknown={self.unknown_keys} "
+            f"[{phases}]"
+        )

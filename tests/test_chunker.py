@@ -71,6 +71,14 @@ def test_empty_document_yields_nothing():
     assert list(chunk_document(b"", 0, 64)) == []
 
 
+def test_normalize_false_is_raw_passthrough():
+    raw = "a — b".encode("utf-8")  # em dash must survive when normalize=False
+    chunks = list(chunk_document(raw, 0, 64, normalize=False))
+    assert bytes(chunks[0].data[: chunks[0].nbytes]) == raw
+    normalized = list(chunk_document(raw, 0, 64, normalize=True))
+    assert bytes(normalized[0].data[: normalized[0].nbytes]) == b"a  b"
+
+
 @pytest.mark.skipif(not CORPUS.exists(), reason="reference corpus not mounted")
 def test_real_corpus_chunking_invariant():
     raw = (CORPUS / "gut-2.txt").read_bytes()

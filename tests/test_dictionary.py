@@ -1,0 +1,67 @@
+"""Dictionary: word extraction parity, collision policy, persistence."""
+
+import pathlib
+
+from mapreduce_rust_tpu.core.hashing import hash_word, hash_words, tokenize_host
+from mapreduce_rust_tpu.core.normalize import normalize_unicode
+from mapreduce_rust_tpu.runtime.dictionary import Dictionary, extract_words
+
+CORPUS = pathlib.Path("/root/reference/src/data")
+
+
+def test_extract_words_matches_bytewise_oracle():
+    text = b"Hello, world! don't-stop  foo_bar42 ... --- a"
+    assert extract_words(text) == tokenize_host(text)
+
+
+def test_extract_words_on_normalized_unicode():
+    raw = "don’t stop — “believing” café naïve now".encode()
+    norm = normalize_unicode(raw)
+    assert extract_words(norm) == tokenize_host(norm)
+    assert b"dont" in extract_words(norm)
+
+
+def test_extract_words_real_corpus_slice():
+    raw = (CORPUS / "gut-2.txt").read_bytes()[:100_000] if CORPUS.exists() else (
+        b"the quick brown fox " * 1000
+    )
+    norm = normalize_unicode(raw)
+    assert extract_words(norm) == tokenize_host(norm)
+
+
+def test_hash_words_matches_scalar_oracle():
+    words = [b"", b"a", b"hello", b"x" * 100, bytes(range(0x80, 0x90))]
+    got = hash_words(words)
+    for w, (h1, h2) in zip(words, got.tolist()):
+        assert (h1, h2) == hash_word(w), w
+
+
+def test_dictionary_lookup_roundtrip(tmp_path):
+    d = Dictionary()
+    added = d.add_text(b"the cat sat on the mat")
+    assert added == 5 and len(d) == 5
+    k1, k2 = hash_word(b"cat")
+    assert d.lookup(k1, k2) == b"cat"
+    assert d.lookup(0, 0) is None
+    # idempotent re-insert
+    assert d.add_text(b"the cat") == 0
+
+    p = tmp_path / "dict.txt"
+    d.save(p)
+    d2 = Dictionary.load(p)
+    assert len(d2) == 5 and d2.lookup(k1, k2) == b"cat"
+
+
+def test_dictionary_merge_and_collision_detection():
+    a = Dictionary()
+    a.add_words([b"alpha", b"beta"])
+    b = Dictionary()
+    b.add_words([b"beta", b"gamma"])
+    a.merge(b)
+    assert len(a) == 3 and not a.collisions
+
+    # Force a collision: same key, different word.
+    c = Dictionary()
+    c._word_of[next(iter(a._word_of))] = b"impostor"
+    a.merge(c)
+    assert len(a.collisions) == 1

@@ -88,18 +88,59 @@ def test_sorted_output_is_front_packed():
 def test_merge_batches_accumulates():
     state = KVBatch.empty(8)
     upd1 = count_unique(make_batch([(1, 1), (2, 2), (1, 1)], [1, 1, 1], 8))
-    state, ovf1 = merge_batches(state, upd1)
+    state, ev1 = merge_batches(state, upd1)
     upd2 = count_unique(make_batch([(2, 2), (3, 3)], [1, 1], 8))
-    state, ovf2 = merge_batches(state, upd2)
-    assert int(ovf1) == 0 and int(ovf2) == 0
+    state, ev2 = merge_batches(state, upd2)
+    assert not np.asarray(ev1.valid).any() and not np.asarray(ev2.valid).any()
     assert batch_to_dict(state) == {(1, 1): 2, (2, 2): 2, (3, 3): 1}
 
 
-def test_merge_overflow_detected():
-    state = make_batch([(i, i) for i in range(4)], [1] * 4, capacity=4)
+def test_merge_overflow_evicts_whole_records():
+    # 8 distinct keys into capacity 4: the 4 largest keys are evicted with
+    # their full merged values — nothing is lost (ADVICE r1).
+    state = make_batch([(i, i) for i in range(4)], [10 + i for i in range(4)], capacity=4)
     upd = make_batch([(i + 100, i) for i in range(4)], [1] * 4, capacity=4)
-    state2, ovf = merge_batches(state, upd)
-    assert int(ovf) == 4  # 8 distinct keys into capacity 4
+    state2, evicted = merge_batches(state, upd)
+    assert evicted.capacity == 4
+    combined = batch_to_dict(state2)
+    for k, v in batch_to_dict(evicted).items():
+        assert k not in combined  # no key in both halves
+        combined[k] = v
+    oracle = {(i, i): 10 + i for i in range(4)}
+    oracle.update({(i + 100, i): 1 for i in range(4)})
+    assert combined == oracle
+
+
+def test_merge_overflow_key_straddles_and_sums():
+    # A key present in state AND update, landing in the evicted tail, must
+    # carry the *summed* value.
+    state = make_batch([(i, 0) for i in range(4)], [1] * 4, capacity=4)
+    upd = make_batch([(3, 0), (0, 0)], [5, 7], capacity=4)
+    state2, evicted = merge_batches(state, upd)
+    combined = {**batch_to_dict(state2), **batch_to_dict(evicted)}
+    assert combined == {(0, 0): 8, (1, 0): 1, (2, 0): 1, (3, 0): 6}
+
+
+def test_distinct_op_dedups_key_value_pairs():
+    # inverted_index semantics: value (doc_id) joins the key; duplicates
+    # collapse, different doc_ids for one term stay distinct.
+    keys = [(1, 1), (1, 1), (1, 1), (2, 2), (2, 2)]
+    vals = [7, 7, 9, 7, 7]
+    out = count_unique(make_batch(keys, vals, capacity=16), op="distinct")
+    got_keys, got_vals = out.to_host()
+    got = sorted(zip(map(tuple, got_keys.tolist()), got_vals.tolist()))
+    assert got == [((1, 1), 7), ((1, 1), 9), ((2, 2), 7)]
+
+
+def test_distinct_op_merges_associatively():
+    a = count_unique(make_batch([(1, 1), (1, 1)], [3, 4], 8), op="distinct")
+    b = count_unique(make_batch([(1, 1), (2, 2)], [4, 5], 8), op="distinct")
+    state, ev = merge_batches(KVBatch.empty(8), a, op="distinct")
+    state, ev2 = merge_batches(state, b, op="distinct")
+    assert not np.asarray(ev.valid).any() and not np.asarray(ev2.valid).any()
+    got_keys, got_vals = state.to_host()
+    got = sorted(zip(map(tuple, got_keys.tolist()), got_vals.tolist()))
+    assert got == [((1, 1), 3), ((1, 1), 4), ((2, 2), 5)]
 
 
 def test_bucket_scatter_routes_by_k1_mod():
