@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""End-to-end benchmark: word-count GB/s on TPU vs the CPU multi-process
+baseline (BASELINE.md configs 1-3).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N}
+
+- Corpus: the 4.11 MB reference corpus (/root/reference/src/data/gut-*.txt)
+  replicated to ~128 MB (cached in .bench/, gitignored).
+- Baseline: a faithful CPU multi-process word count — the reference's exact
+  per-task work (regex strip + split + Counter; src/app/wc.rs:6-17) over
+  whitespace-aligned byte slices on a worker pool, like its map_n×worker_n
+  process model (src/bin/mrworker.rs:43-151). Measured on a 32 MB slice.
+- TPU run: the full framework path (normalize → chunk → device tokenize/
+  hash/sort/segment-reduce → merge → dictionary egress), compile excluded
+  via a warmup pass over a small prefix (jit caches are in-process).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import multiprocessing
+import os
+import pathlib
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent
+REF_DATA = pathlib.Path("/root/reference/src/data")
+BENCH_DIR = REPO / ".bench"
+TARGET_MB = int(os.environ.get("BENCH_TARGET_MB", "128"))
+BASELINE_MB = int(os.environ.get("BENCH_BASELINE_MB", "32"))
+
+_WS = b" \t\n\r\x0b\x0c"
+
+
+def build_corpus(target_mb: int) -> pathlib.Path:
+    out = BENCH_DIR / f"corpus-{target_mb}mb.txt"
+    if out.exists() and out.stat().st_size >= target_mb << 20:
+        return out
+    BENCH_DIR.mkdir(exist_ok=True)
+    if REF_DATA.exists():
+        seed = b"\n".join(p.read_bytes() for p in sorted(REF_DATA.glob("gut-*.txt")))
+    else:  # synthetic fallback
+        import random
+
+        rng = random.Random(0)
+        seed = (" ".join(f"w{rng.randrange(100000)}" for _ in range(2_000_000))).encode()
+    with open(out, "wb") as f:
+        written = 0
+        while written < target_mb << 20:
+            f.write(seed)
+            f.write(b"\n")
+            written += len(seed) + 1
+    return out
+
+
+def _ws_aligned_slices(path: pathlib.Path, n: int, limit: int | None = None):
+    """n byte ranges cut at whitespace (reading only boundary probes)."""
+    size = min(path.stat().st_size, limit or (1 << 62))
+    bounds = [0]
+    with open(path, "rb") as f:
+        for i in range(1, n):
+            pos = size * i // n
+            f.seek(pos)
+            tail = f.read(1 << 16)
+            off = next((j for j, b in enumerate(tail) if b in _WS), 0)
+            bounds.append(pos + off)
+    bounds.append(size)
+    return [(int(a), int(b)) for a, b in zip(bounds, bounds[1:])]
+
+
+def _count_slice(args) -> collections.Counter:
+    path, start, end = args
+    from mapreduce_rust_tpu.core.normalize import reference_word_counts
+
+    with open(path, "rb") as f:
+        f.seek(start)
+        return reference_word_counts(f.read(end - start))
+
+
+def cpu_baseline_gbs(path: pathlib.Path, limit_bytes: int, workers: int = 8) -> float:
+    """Multi-process reference-semantics word count, GB/s."""
+    slices = _ws_aligned_slices(path, workers, limit_bytes)
+    t0 = time.perf_counter()
+    with multiprocessing.Pool(workers) as pool:
+        parts = pool.map(_count_slice, [(str(path), a, b) for a, b in slices])
+    total = collections.Counter()
+    for c in parts:
+        total.update(c)
+    dt = time.perf_counter() - t0
+    assert len(total) > 0
+    return limit_bytes / dt / 1e9
+
+
+def tpu_run_gbs(path: pathlib.Path) -> tuple[float, dict]:
+    from mapreduce_rust_tpu.config import Config
+    from mapreduce_rust_tpu.runtime.driver import run_job
+
+    cfg = Config(
+        chunk_bytes=1 << 22,
+        merge_capacity=1 << 21,
+        reduce_n=4,
+        output_dir=str(BENCH_DIR / "out"),
+        device="auto",
+    )
+    # Warmup: compile every jitted step on a small prefix with identical
+    # static shapes (first TPU compile is ~20-40 s and must not be timed).
+    warm = BENCH_DIR / "warmup.txt"
+    with open(path, "rb") as f:
+        warm.write_bytes(f.read(cfg.chunk_bytes + 1024))
+    run_job(cfg, [str(warm)], write_outputs=False)
+
+    res = run_job(cfg, [str(path)])
+    info = {
+        "bytes": res.stats.bytes_in,
+        "wall_s": round(res.stats.wall_seconds, 3),
+        "distinct": res.stats.distinct_keys,
+        "chunks": res.stats.chunks,
+        "spills": res.stats.spill_events,
+        "collisions": res.stats.hash_collisions,
+        "phases": {k: round(v, 3) for k, v in res.stats.phase_seconds.items()},
+    }
+    return res.stats.gb_per_s, info
+
+
+def main() -> None:
+    corpus = build_corpus(TARGET_MB)
+    gbs, info = tpu_run_gbs(corpus)
+    base_gbs = cpu_baseline_gbs(corpus, min(BASELINE_MB << 20, corpus.stat().st_size))
+    result = {
+        "metric": f"word_count GB/s end-to-end ({TARGET_MB}MB corpus, single TPU chip "
+        f"vs {BASELINE_MB}MB 8-proc CPU baseline)",
+        "value": round(gbs, 4),
+        "unit": "GB/s",
+        "vs_baseline": round(gbs / base_gbs, 2) if base_gbs else None,
+    }
+    print(json.dumps(result))
+    print(
+        json.dumps({"detail": info, "cpu_baseline_gbs": round(base_gbs, 4)}),
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

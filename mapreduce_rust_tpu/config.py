@@ -27,8 +27,9 @@ class Config:
     max_word_len: int = 64          # device tokenizer halo / truncation cap
     merge_capacity: int = 1 << 21   # running distinct-key capacity on device
     partial_capacity: Optional[int] = None  # per-chunk distinct-key cap
-                                    # (None → chunk_bytes // 8; overflow
-                                    # replays the chunk full-width, exact)
+                                    # (None → max(chunk_bytes // 8, 1024);
+                                    # overflow replays the chunk full-width,
+                                    # exact — see effective_partial_capacity)
     bucket_capacity_factor: float = 2.0  # all_to_all per-bucket slack
     device: str = "auto"            # "auto" | "tpu" | "cpu"
     mesh_shape: Optional[int] = None  # devices in the 1-D mesh (None = all)
@@ -52,3 +53,8 @@ class Config:
             raise ValueError("map_n, reduce_n, worker_n must be positive")
         if self.chunk_bytes <= 2 * self.max_word_len:
             raise ValueError("chunk_bytes too small for max_word_len halo")
+
+    def effective_partial_capacity(self) -> int:
+        """The per-chunk distinct-key capacity both stream paths must share
+        (single-chip and mesh replay rates stay comparable)."""
+        return self.partial_capacity or max(self.chunk_bytes // 8, 1024)

@@ -89,17 +89,34 @@ def hash_words(words: list[bytes]) -> np.ndarray:
     if n == 0:
         return out
     lens = np.fromiter((len(w) for w in words), dtype=np.int64, count=n)
-    # Batches are length-sorted so each group's matrix is sized by its OWN
-    # longest word — one pathological multi-MB token (a force-cut fragment
-    # of whitespace-free input) costs only its own group, never
-    # n × maxlen memory.
+    # Length-sorted, memory-bounded groups: each group's padded matrix is
+    # at most _GROUP_BYTES, so one pathological multi-MB token (a force-cut
+    # fragment of whitespace-free input) can never inflate the whole
+    # batch's padding. Words past _SCALAR_LEN take the per-word loop — the
+    # column-wise numpy sweep degrades below Python speed at that length.
     order = np.argsort(lens, kind="stable")
-    group = 4096
-    for g0 in range(0, n, group):
-        idx = order[g0 : g0 + group]
+    GROUP_ROWS, GROUP_BYTES, SCALAR_LEN = 4096, 64 << 20, 1 << 14
+    g0 = 0
+    while g0 < n:
+        gmax = max(int(lens[order[g0]]), 1)
+        if gmax > SCALAR_LEN:
+            i = int(order[g0])
+            out[i] = hash_word(words[i])
+            g0 += 1
+            continue
+        g1 = g0
+        while (
+            g1 < n
+            and g1 - g0 < GROUP_ROWS
+            and lens[order[g1]] <= SCALAR_LEN
+            and (g1 - g0 + 1) * max(int(lens[order[g1]]), 1) <= GROUP_BYTES
+        ):
+            gmax = max(int(lens[order[g1]]), 1)
+            g1 += 1
+        idx = order[g0:g1]
+        g0 = g1
         glens = lens[idx]
-        gmax = int(glens.max())
-        mat = np.zeros((len(idx), max(gmax, 1)), dtype=np.uint8)
+        mat = np.zeros((len(idx), gmax), dtype=np.uint8)
         for row, i in enumerate(idx.tolist()):
             w = words[i]
             if w:

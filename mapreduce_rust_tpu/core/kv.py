@@ -38,6 +38,11 @@ class KVBatch(NamedTuple):
     def capacity(self) -> int:
         return self.k1.shape[-1]
 
+    def take_front(self, n: int) -> "KVBatch":
+        """First n slots. Reduce outputs are front-packed (ops/groupby.py),
+        so this is the compaction primitive for partial/update batches."""
+        return KVBatch(self.k1[:n], self.k2[:n], self.value[:n], self.valid[:n])
+
     @staticmethod
     def empty(capacity: int) -> "KVBatch":
         return KVBatch(

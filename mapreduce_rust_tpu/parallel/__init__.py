@@ -1,0 +1,8 @@
+"""Parallel tier: mesh construction, ICI all-to-all shuffle, halo exchange."""
+
+from mapreduce_rust_tpu.parallel.shuffle import (  # noqa: F401
+    AXIS,
+    make_mesh,
+    make_shuffle_step_fns,
+    sharded_empty_state,
+)

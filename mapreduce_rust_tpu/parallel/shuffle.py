@@ -1,0 +1,151 @@
+"""The ICI all-to-all shuffle — the north-star hot path.
+
+Replaces the reference's file-plane shuffle: there every map task routes
+each KV pair by ``DefaultHasher(key) % reduce_n`` into one of reduce_n
+files with one awaited write + one println per pair
+(src/mr/worker.rs:117-140), and reduce tasks read the files back by name
+(worker.rs:79-109). Here the "files" are rows of a bucket-major device
+array and the routing is one ``lax.all_to_all`` over the ICI mesh inside
+``shard_map``:
+
+    per chip:  tokenize → app.device_map → count_unique (map-side combiner)
+               → bucket_scatter into D buckets (bucket = k1 % D)
+    all chips: all_to_all — bucket d of every chip lands on chip d
+    per chip:  count_unique over the received records → this chip's
+               distinct keys (its hash class) → merge into its state shard
+
+Keys are disjoint across chips after the shuffle (chip d owns exactly the
+keys with k1 % D == d), so per-chip states merge/spill independently and
+the job total is the union of shard results — same invariant the
+reference gets from hash % reduce_n file naming.
+
+Static shapes under jit mean fixed bucket capacity; skewed buckets can
+overflow (SURVEY.md §7 hard part 2). Overflow is *counted before the merge*
+and the driver replays that group through a lazily-compiled full-width
+path (bucket capacity = the whole update), so results are exact always —
+the fast path is just sized by ``Config.bucket_capacity_factor``.
+
+Multi-host: the same code runs over a global mesh after
+``jax.distributed.initialize`` — the all_to_all then rides ICI intra-slice
+and DCN across slices. This environment is single-host, so that path is
+exercised only as far as compilation (see __graft_entry__.dryrun_multichip).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mapreduce_rust_tpu.apps.base import App
+from mapreduce_rust_tpu.core.kv import KVBatch
+from mapreduce_rust_tpu.ops.groupby import count_unique, merge_batches
+from mapreduce_rust_tpu.ops.partition import bucket_scatter
+from mapreduce_rust_tpu.ops.tokenize import tokenize_and_hash
+
+AXIS = "shards"
+
+
+def make_mesh(n_devices: int | None = None, backend: str | None = None) -> Mesh:
+    """1-D device mesh. Prefers the default backend (TPU when present); falls
+    back to the (virtual-device) CPU backend when it is too small — the
+    SURVEY §4 strategy for testing multi-chip code on a 1-chip host."""
+    devs = jax.devices(backend) if backend else jax.devices()
+    n = n_devices or len(devs)
+    if len(devs) < n and backend is None:
+        devs = jax.devices("cpu")
+    if len(devs) < n:
+        raise RuntimeError(f"need {n} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:n]), (AXIS,))
+
+
+def state_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(AXIS, None))
+
+
+def sharded_empty_state(mesh: Mesh, capacity_per_shard: int) -> KVBatch:
+    """KVBatch [D, capacity] sharded one row per chip."""
+    d = mesh.devices.size
+    host = KVBatch.empty(capacity_per_shard)
+    stacked = KVBatch(*(np.broadcast_to(np.asarray(x), (d,) + x.shape).copy() for x in host))
+    return jax.device_put(stacked, state_sharding(mesh))
+
+
+def make_shuffle_step_fns(app: App, u_cap: int, bucket_cap: int, mesh: Mesh):
+    """(map_shuffle, merge) — the group-of-D-chunks mesh pipeline.
+
+    map_shuffle: chunks [D, chunk_bytes], doc_ids [D] →
+        (local KVBatch [D, D*bucket_cap], partial_ovf [D], bucket_ovf [D]).
+        partial_ovf counts distinct keys truncated by the u_cap compaction;
+        bucket_ovf counts records dropped by bucket skew beyond bucket_cap.
+        Either nonzero → the driver replays the group through a wider tier
+        (bucket_cap=u_cap kills bucket overflow by construction;
+        u_cap=chunk capacity kills partial overflow) — results stay exact.
+    merge: (state [D, cap], local) → (state, evicted [D, D*bucket_cap],
+        evicted_counts [D]), donating the old state.
+    """
+    op = app.combine_op
+    d = mesh.devices.size
+
+    def _one_chip_map(chunk: jnp.ndarray, doc_id: jnp.ndarray):
+        kv = tokenize_and_hash(chunk)
+        kv = app.device_map(kv, doc_id)
+        partial = count_unique(kv, op=op)
+        update = partial.take_front(u_cap)
+        p_ovf = jnp.sum(partial.valid[u_cap:].astype(jnp.int32))
+        buckets, b_ovf = bucket_scatter(update, num_buckets=d, capacity=bucket_cap)
+        return buckets, p_ovf, b_ovf
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(AXIS), P(AXIS)),
+        out_specs=(P(AXIS), P(AXIS), P(AXIS)),
+    )
+    def map_shuffle(chunks: jnp.ndarray, doc_ids: jnp.ndarray):
+        buckets, p_ovf, b_ovf = _one_chip_map(chunks[0], doc_ids[0])
+        # buckets: [d, bucket_cap] bucket-major — exactly the split layout
+        # all_to_all wants. Row i goes to chip i; chip i concatenates the
+        # d rows it receives (one per source chip).
+        recv = jax.tree.map(
+            lambda x: jax.lax.all_to_all(x, AXIS, split_axis=0, concat_axis=0, tiled=True),
+            buckets,
+        )
+        flat = KVBatch(*(x.reshape(-1) for x in recv))  # [d * bucket_cap]
+        local = count_unique(flat, op=op)  # distinct keys of MY hash class
+        return (
+            KVBatch(*(x[None] for x in local)),
+            p_ovf[None],
+            b_ovf[None],
+        )
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(AXIS, None), P(AXIS)),
+        out_specs=(P(AXIS, None), P(AXIS), P(AXIS)),
+    )
+    def merge(state: KVBatch, local: KVBatch):
+        st = KVBatch(*(x[0] for x in state))
+        lc = KVBatch(*(x[0] for x in local))
+        new_state, evicted = merge_batches(st, lc, op=op)
+        ev_count = jnp.sum(evicted.valid.astype(jnp.int32))
+        return (
+            KVBatch(*(x[None] for x in new_state)),
+            KVBatch(*(x[None] for x in evicted)),
+            ev_count[None],
+        )
+
+    return map_shuffle, merge
+
+
+def default_bucket_cap(u_cap: int, n_devices: int, factor: float) -> int:
+    """Per-(src,dst) bucket capacity: even split × slack factor, padded to
+    the next multiple of 8 for TPU-friendly layouts."""
+    cap = math.ceil(u_cap / n_devices * factor)
+    return min(u_cap, (cap + 7) // 8 * 8)

@@ -69,15 +69,17 @@ class Dictionary:
         return self._word_of.get((k1, k2))
 
     def add_words(self, words: Iterable[bytes]) -> int:
-        """Insert unseen words; returns the number of new entries."""
-        fresh: list[bytes] = []
-        seen = self._seen
-        for w in words:
-            if w not in seen:
-                seen.add(w)
-                fresh.append(w)
-        if not fresh:
+        """Insert unseen words; returns the number of new entries.
+
+        Dedup is C-speed set algebra (set() + difference), not a per-token
+        Python loop — this runs once per chunk on the ingest hot path,
+        overlapped with device compute.
+        """
+        fresh_set = set(words) - self._seen
+        if not fresh_set:
             return 0
+        self._seen |= fresh_set
+        fresh = list(fresh_set)
         keys = hash_words(fresh)
         added = 0
         word_of = self._word_of
@@ -98,6 +100,7 @@ class Dictionary:
         return iter(self._word_of.items())
 
     def merge(self, other: "Dictionary") -> None:
+        self.collisions.extend(other.collisions)
         for key, w in other._word_of.items():
             prev = self._word_of.get(key)
             if prev is None:
@@ -111,8 +114,12 @@ class Dictionary:
     # merge them — the TPU analog of the reference's mr-{m}-{r}.txt files) --
 
     def save(self, path: str | os.PathLike) -> None:
-        """Words contain no whitespace bytes, so 'k1 k2 word' lines are safe."""
+        """Words contain no whitespace bytes, so 'k1 k2 word' lines are safe;
+        collision events persist as '! kept rejected' lines so shard merges
+        never lose collision accounting."""
         with open(path, "wb") as f:
+            for kept, rejected in self.collisions:
+                f.write(b"! %s %s\n" % (kept, rejected))
             for (k1, k2), w in self._word_of.items():
                 f.write(b"%d %d %s\n" % (k1, k2, w))
 
@@ -121,6 +128,10 @@ class Dictionary:
         d = cls()
         with open(path, "rb") as f:
             for line in f:
+                if line.startswith(b"! "):
+                    _, kept, rejected = line.rstrip(b"\n").split(b" ", 2)
+                    d.collisions.append((kept, rejected))
+                    continue
                 a, b, w = line.rstrip(b"\n").split(b" ", 2)
                 d._word_of[(int(a), int(b))] = w
                 d._seen.add(w)

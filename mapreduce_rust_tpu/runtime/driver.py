@@ -1,4 +1,4 @@
-"""Chunked streaming driver — the end-to-end single-controller engine.
+"""Chunked streaming driver — the end-to-end engine (single-chip and mesh).
 
 This is the TPU-native replacement for the reference's whole worker
 execution path (src/mr/worker.rs:65-193): instead of per-task files and
@@ -12,16 +12,24 @@ distinct-key state on device:
          evicted tail (rare) ◀─────────────────────┘
               └─▶ host spill accumulator (exact, nothing dropped)
 
+With ``cfg.mesh_shape > 1`` the same loop feeds groups of D chunks to the
+mesh pipeline (parallel/shuffle.py): per-chip combine → bucket scatter →
+``lax.all_to_all`` over ICI → per-chip merge into a hash-class-sharded
+state. That collective IS the reference's mr-{m}-{r}.txt file shuffle
+(src/mr/worker.rs:117-140), lowered to the interconnect.
+
 The loop is pipelined: JAX dispatch is async, so while the device works on
 chunk k the host normalizes/chunks k+1 and feeds the egress dictionary
-(runtime/dictionary.py). Device sync points are two chunks behind dispatch
+(runtime/dictionary.py). Device sync points trail dispatch by two steps
 (overflow/spill counters), so the device never idles on the host.
 
-Capacity faults are handled, not asserted (VERDICT r1 "weak" 3):
-- per-chunk distinct keys > partial_capacity → the chunk is *replayed*
-  through a lazily-compiled full-width path (counted, exact);
-- merged distinct keys > merge_capacity → the evicted tail spills whole to
-  the host accumulator (ops/groupby.merge_batches; counted, exact).
+Capacity faults are handled, not asserted (VERDICT r1 weak 3):
+- per-chunk distinct keys > partial_capacity → the chunk/group is
+  *replayed* through a lazily-compiled wider tier (counted, exact);
+- mesh bucket skew > bucket capacity → same replay, tier sized so bucket
+  overflow is impossible (bucket_cap = whole update);
+- merged distinct keys > merge_capacity → the evicted tail spills whole
+  to the host accumulator (ops/groupby.merge_batches; counted, exact).
 
 At egress the final table joins the hash→word dictionary and each app
 formats its partitions (apps/base.py), written as mr-{r}.txt like the
@@ -52,7 +60,7 @@ from mapreduce_rust_tpu.runtime.chunker import chunk_stream, list_inputs
 from mapreduce_rust_tpu.runtime.dictionary import Dictionary
 from mapreduce_rust_tpu.runtime.metrics import JobStats, log
 
-_PIPELINE_DEPTH = 2  # device sync trails dispatch by this many chunks
+_PIPELINE_DEPTH = 2  # device sync trails dispatch by this many steps
 
 
 def select_device(kind: str = "auto"):
@@ -63,10 +71,6 @@ def select_device(kind: str = "auto"):
     if not devs:
         raise RuntimeError(f"no {kind} devices available")
     return devs[0]
-
-
-def _slice(batch: KVBatch, n: int) -> KVBatch:
-    return KVBatch(batch.k1[:n], batch.k2[:n], batch.value[:n], batch.valid[:n])
 
 
 def make_step_fns(app: App, u_cap: int):
@@ -83,7 +87,7 @@ def make_step_fns(app: App, u_cap: int):
         kv = tokenize_and_hash(chunk)
         kv = app.device_map(kv, doc_id)
         partial = count_unique(kv, op=op)
-        update = _slice(partial, u_cap)
+        update = partial.take_front(u_cap)
         ovf = jnp.sum(partial.valid[u_cap:].astype(jnp.int32))
         return update, ovf
 
@@ -118,6 +122,10 @@ class HostAccumulator:
             else:
                 t[k] = v if k not in t else min(t[k], v)
 
+    def add_batch(self, batch: KVBatch) -> None:
+        keys, vals = batch.to_host()
+        self.add(keys, vals)
+
 
 @dataclasses.dataclass
 class JobResult:
@@ -126,29 +134,28 @@ class JobResult:
     output_files: list[str]
 
 
-def run_job(
-    cfg: Config,
-    inputs: Sequence[str] | None = None,
-    app: App | None = None,
-    write_outputs: bool = True,
-) -> JobResult:
-    """Run one job end-to-end on a single device. Returns exact results."""
-    t0 = time.perf_counter()
-    app = app or WordCount()
-    inputs = list(inputs) if inputs is not None else list_inputs(cfg.input_dir, cfg.input_pattern)
-    if not inputs:
-        raise ValueError("no input files")
+def _iter_input_chunks(cfg: Config, inputs: Sequence[str], stats: JobStats, dictionary: Dictionary):
+    """Shared ingest: stream chunks, feeding stats + the egress dictionary."""
+    for doc_id, path in enumerate(inputs):
+        stats.bytes_in += os.path.getsize(path)
+        with open(path, "rb") as f:
+            for chunk in chunk_stream(f, doc_id, cfg.chunk_bytes):
+                dictionary.add_text(bytes(chunk.data[: chunk.nbytes]))
+                stats.chunks += 1
+                stats.forced_cuts += int(chunk.forced_cut)
+                log.debug("chunk %d doc=%d %dB", stats.chunks, chunk.doc_id, chunk.nbytes)
+                yield chunk
+
+
+def _stream_single(cfg: Config, app: App, inputs, stats, acc, dictionary) -> None:
     device = select_device(cfg.device)
-    u_cap = cfg.partial_capacity or max(cfg.chunk_bytes // 8, 1024)
+    u_cap = cfg.effective_partial_capacity()
     map_combine, merge = make_step_fns(app, u_cap)
     slow_fns = None  # full-width replay path, compiled only if ever needed
 
-    stats = JobStats()
-    acc = HostAccumulator(app.combine_op)
-    dictionary = Dictionary()
     state = jax.device_put(KVBatch.empty(cfg.merge_capacity), device)
-    mc_pending: collections.deque = collections.deque()  # (update, ovf, chunk_dev, doc_id)
-    sp_pending: collections.deque = collections.deque()  # (evicted, ev_count)
+    mc_pending: collections.deque = collections.deque()
+    sp_pending: collections.deque = collections.deque()
 
     def resolve_map_combine() -> None:
         nonlocal state, slow_fns
@@ -171,36 +178,134 @@ def run_job(
         if n > 0:
             stats.spill_events += 1
             stats.spilled_keys += n
-            keys, vals = evicted.to_host()
-            acc.add(keys, vals)
+            acc.add_batch(evicted)
 
-    with stats.phase("stream"):
-        for doc_id, path in enumerate(inputs):
-            stats.bytes_in += os.path.getsize(path)
-            f = open(path, "rb")
-            for chunk in chunk_stream(f, doc_id, cfg.chunk_bytes):
-                chunk_dev = jax.device_put(chunk.data, device)
-                did = jax.device_put(np.int32(chunk.doc_id), device)
-                update, ovf = map_combine(chunk_dev, did)
-                mc_pending.append((update, ovf, chunk_dev, did))
-                # Host work below overlaps the async device dispatch above.
-                dictionary.add_text(bytes(chunk.data[: chunk.nbytes]))
-                stats.chunks += 1
-                stats.forced_cuts += int(chunk.forced_cut)
-                if len(mc_pending) > _PIPELINE_DEPTH:
-                    resolve_map_combine()
-                if len(sp_pending) > _PIPELINE_DEPTH:
-                    resolve_spill()
-                log.debug("chunk %d doc=%d %dB", stats.chunks, chunk.doc_id, chunk.nbytes)
-            f.close()
-        while mc_pending:
+    for chunk in _iter_input_chunks(cfg, inputs, stats, dictionary):
+        chunk_dev = jax.device_put(chunk.data, device)
+        did = jax.device_put(np.int32(chunk.doc_id), device)
+        update, ovf = map_combine(chunk_dev, did)
+        mc_pending.append((update, ovf, chunk_dev, did))
+        if len(mc_pending) > _PIPELINE_DEPTH:
             resolve_map_combine()
-        while sp_pending:
+        if len(sp_pending) > _PIPELINE_DEPTH:
+            resolve_spill()
+    while mc_pending:
+        resolve_map_combine()
+    while sp_pending:
+        resolve_spill()
+    acc.add_batch(state)
+
+
+def _stream_mesh(cfg: Config, app: App, inputs, stats, acc, dictionary) -> None:
+    """Group-of-D-chunks pipeline over the 1-D mesh (parallel/shuffle.py)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from mapreduce_rust_tpu.parallel.shuffle import (
+        AXIS,
+        default_bucket_cap,
+        make_mesh,
+        make_shuffle_step_fns,
+        sharded_empty_state,
+    )
+
+    backend = None if cfg.device == "auto" else cfg.device
+    mesh = make_mesh(cfg.mesh_shape, backend)
+    d = mesh.devices.size
+    u_cap = cfg.effective_partial_capacity()
+    bucket_cap = default_bucket_cap(u_cap, d, cfg.bucket_capacity_factor)
+    fast = make_shuffle_step_fns(app, u_cap, bucket_cap, mesh)
+    tiers: dict[str, tuple] = {}  # lazily-compiled exact replay paths
+
+    state = sharded_empty_state(mesh, max(cfg.merge_capacity // d, 16))
+    in_shard = NamedSharding(mesh, P(AXIS))
+    mc_pending: collections.deque = collections.deque()
+    sp_pending: collections.deque = collections.deque()
+
+    def resolve_group() -> None:
+        nonlocal state
+        local, p_ovf, b_ovf, chunks_dev, docs_dev, fns = mc_pending.popleft()
+        if int(jnp.sum(p_ovf)) > 0:
+            # A chunk had more distinct keys than u_cap: widest tier.
+            stats.partial_overflow_replays += 1
+            if "full" not in tiers:
+                tiers["full"] = make_shuffle_step_fns(
+                    app, cfg.chunk_bytes, cfg.chunk_bytes, mesh
+                )
+            fns = tiers["full"]
+            local, _, _ = fns[0](chunks_dev, docs_dev)
+        elif int(jnp.sum(b_ovf)) > 0:
+            # Bucket skew: bucket_cap=u_cap makes overflow impossible.
+            stats.bucket_skew_replays += 1
+            if "skew" not in tiers:
+                tiers["skew"] = make_shuffle_step_fns(app, u_cap, u_cap, mesh)
+            fns = tiers["skew"]
+            local, _, _ = fns[0](chunks_dev, docs_dev)
+        state, evicted, ev_counts = fns[1](state, local)
+        sp_pending.append((evicted, ev_counts))
+
+    def resolve_spill() -> None:
+        evicted, ev_counts = sp_pending.popleft()
+        n = int(jnp.sum(ev_counts))
+        if n > 0:
+            stats.spill_events += 1
+            stats.spilled_keys += n
+            acc.add_batch(evicted)
+
+    group_chunks: list[np.ndarray] = []
+    group_docs: list[int] = []
+
+    def submit_group() -> None:
+        while len(group_chunks) < d:  # pad the tail group with space chunks
+            group_chunks.append(np.full(cfg.chunk_bytes, 0x20, dtype=np.uint8))
+            group_docs.append(0)
+        chunks_dev = jax.device_put(np.stack(group_chunks), in_shard)
+        docs_dev = jax.device_put(np.asarray(group_docs, dtype=np.int32), in_shard)
+        group_chunks.clear()
+        group_docs.clear()
+        local, p_ovf, b_ovf = fast[0](chunks_dev, docs_dev)
+        mc_pending.append((local, p_ovf, b_ovf, chunks_dev, docs_dev, fast))
+        if len(mc_pending) > _PIPELINE_DEPTH:
+            resolve_group()
+        if len(sp_pending) > _PIPELINE_DEPTH:
             resolve_spill()
 
+    for chunk in _iter_input_chunks(cfg, inputs, stats, dictionary):
+        group_chunks.append(chunk.data)
+        group_docs.append(chunk.doc_id)
+        if len(group_chunks) == d:
+            submit_group()
+    if group_chunks:
+        submit_group()
+    while mc_pending:
+        resolve_group()
+    while sp_pending:
+        resolve_spill()
+    acc.add_batch(state)
+
+
+def run_job(
+    cfg: Config,
+    inputs: Sequence[str] | None = None,
+    app: App | None = None,
+    write_outputs: bool = True,
+) -> JobResult:
+    """Run one job end-to-end. Exact results on any device/mesh shape."""
+    t0 = time.perf_counter()
+    app = app or WordCount()
+    inputs = list(inputs) if inputs is not None else list_inputs(cfg.input_dir, cfg.input_pattern)
+    if not inputs:
+        raise ValueError("no input files")
+
+    stats = JobStats()
+    acc = HostAccumulator(app.combine_op)
+    dictionary = Dictionary()
+
+    with stats.phase("stream"):
+        if cfg.mesh_shape and cfg.mesh_shape > 1:
+            _stream_mesh(cfg, app, inputs, stats, acc, dictionary)
+        else:
+            _stream_single(cfg, app, inputs, stats, acc, dictionary)
+
     with stats.phase("finalize"):
-        keys, vals = state.to_host()
-        acc.add(keys, vals)
         stats.distinct_keys = len(acc.table)
         stats.dictionary_words = len(dictionary)
         stats.hash_collisions = len(dictionary.collisions)

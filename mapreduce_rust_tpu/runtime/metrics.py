@@ -26,6 +26,7 @@ class JobStats:
     spill_events: int = 0         # merges whose evicted tail was non-empty
     spilled_keys: int = 0         # records moved device → host accumulator
     partial_overflow_replays: int = 0  # chunks re-run on the full-width path
+    bucket_skew_replays: int = 0       # mesh groups re-run on the skew tier
     dictionary_words: int = 0
     hash_collisions: int = 0
     unknown_keys: int = 0         # final keys missing from the dictionary
@@ -53,7 +54,7 @@ class JobStats:
             f"({self.gb_per_s:.3f} GB/s) chunks={self.chunks} "
             f"distinct={self.distinct_keys} dict={self.dictionary_words} "
             f"spills={self.spill_events}({self.spilled_keys} keys) "
-            f"replays={self.partial_overflow_replays} "
+            f"replays={self.partial_overflow_replays}+{self.bucket_skew_replays}skew "
             f"collisions={self.hash_collisions} unknown={self.unknown_keys} "
             f"[{phases}]"
         )
