@@ -1,0 +1,169 @@
+"""CLI entry points — the counterpart of the reference's binaries + shell
+tooling (src/bin/mrcoordinator.rs, src/bin/mrworker.rs, src/run.sh,
+src/clean.sh), as subcommands of one module:
+
+    python -m mapreduce_rust_tpu run         # single-process driver (TPU path)
+    python -m mapreduce_rust_tpu coordinator # control plane (multi-process)
+    python -m mapreduce_rust_tpu worker      # pull-based worker process
+    python -m mapreduce_rust_tpu merge       # mr-*.txt → final.txt
+    python -m mapreduce_rust_tpu clean       # rm intermediates/outputs
+
+Unlike the reference — where the worker learns map_n/reduce_n from its own
+argv and a mismatch silently mis-shards the shuffle (SURVEY.md §3-E) — both
+sides derive map_n from the same sorted input listing and reduce_n travels
+with every spill filename, so a mismatch is loud.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import glob
+import logging
+import os
+import sys
+
+from mapreduce_rust_tpu.apps import REGISTRY, get_app
+from mapreduce_rust_tpu.config import Config
+
+
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--input", default="data", help="input directory")
+    p.add_argument("--pattern", default="*.txt")
+    p.add_argument("--output", default="mr-out")
+    p.add_argument("--work", default="mr-work")
+    p.add_argument("--app", default="word_count", choices=sorted(REGISTRY))
+    p.add_argument("--k", type=int, default=20, help="top_k selection size")
+    p.add_argument("--reduce-n", type=int, default=4)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=1040)
+    p.add_argument("--chunk-mb", type=float, default=4.0)
+    p.add_argument("--device", default="auto", choices=["auto", "tpu", "cpu"])
+    p.add_argument("-v", "--verbose", action="store_true")
+
+
+def _cfg(args, map_n: int = 1, worker_n: int = 1) -> Config:
+    return Config(
+        map_n=max(map_n, 1),
+        reduce_n=args.reduce_n,
+        worker_n=worker_n,
+        chunk_bytes=int(args.chunk_mb * (1 << 20)),
+        device=args.device,
+        mesh_shape=getattr(args, "mesh", None),
+        host=args.host,
+        port=args.port,
+        input_dir=args.input,
+        input_pattern=args.pattern,
+        work_dir=args.work,
+        output_dir=args.output,
+    )
+
+
+def _app(args):
+    return get_app(args.app, k=args.k) if args.app == "top_k" else get_app(args.app)
+
+
+def cmd_run(args) -> int:
+    from mapreduce_rust_tpu.runtime.driver import run_job
+    from mapreduce_rust_tpu.runtime.chunker import list_inputs
+
+    inputs = list_inputs(args.input, args.pattern)
+    cfg = _cfg(args, map_n=len(inputs))
+    res = run_job(cfg, inputs, app=_app(args))
+    print(res.stats.summary())
+    print(f"outputs: {', '.join(res.output_files)}")
+    return 0
+
+
+def cmd_coordinator(args) -> int:
+    from mapreduce_rust_tpu.coordinator.server import Coordinator
+    from mapreduce_rust_tpu.runtime.chunker import list_inputs
+
+    inputs = list_inputs(args.input, args.pattern)
+    if not inputs:
+        print(f"no inputs matching {args.pattern} in {args.input}", file=sys.stderr)
+        return 2
+    cfg = _cfg(args, map_n=len(inputs), worker_n=args.worker_n)
+    asyncio.run(Coordinator(cfg).serve())
+    return 0
+
+
+def cmd_worker(args) -> int:
+    from mapreduce_rust_tpu.runtime.chunker import list_inputs
+    from mapreduce_rust_tpu.worker.runtime import Worker
+
+    inputs = list_inputs(args.input, args.pattern)
+    cfg = _cfg(args, map_n=len(inputs))
+    worker = Worker(cfg, app=_app(args), engine=args.engine)
+    asyncio.run(worker.run())
+    return 0
+
+
+def cmd_merge(args) -> int:
+    app = _app(args)
+    lines: list[bytes] = []
+    files = sorted(glob.glob(os.path.join(args.output, "mr-*.txt")))
+    for path in files:
+        with open(path, "rb") as f:
+            lines.extend(f.read().splitlines())
+    out = os.path.join(args.output, "final.txt")
+    with open(out, "wb") as f:
+        for line in app.merge_lines(lines):
+            f.write(line + b"\n")
+    print(f"{out}: {len(files)} partitions merged")
+    return 0
+
+
+def cmd_clean(args) -> int:
+    """Reference src/clean.sh:7-12: remove intermediates + outputs."""
+    removed = 0
+    for pattern in ("mr-*.npz", "dict-*.txt"):
+        for p in glob.glob(os.path.join(args.work, pattern)):
+            os.remove(p)
+            removed += 1
+    for pattern in ("mr-*.txt", "final.txt"):
+        for p in glob.glob(os.path.join(args.output, pattern)):
+            os.remove(p)
+            removed += 1
+    print(f"removed {removed} files")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="mapreduce_rust_tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("run", help="single-process end-to-end job (TPU path)")
+    _add_common(p)
+    p.add_argument("--mesh", type=int, default=None, help="devices in the 1-D mesh")
+
+    p = sub.add_parser("coordinator", help="control-plane scheduler")
+    _add_common(p)
+    p.add_argument("--worker-n", type=int, default=1)
+
+    p = sub.add_parser("worker", help="pull-based worker process")
+    _add_common(p)
+    p.add_argument("--engine", default="host", choices=["host", "device"])
+
+    p = sub.add_parser("merge", help="merge mr-*.txt into final.txt")
+    _add_common(p)
+
+    p = sub.add_parser("clean", help="remove intermediates and outputs")
+    _add_common(p)
+
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    return {
+        "run": cmd_run,
+        "coordinator": cmd_coordinator,
+        "worker": cmd_worker,
+        "merge": cmd_merge,
+        "clean": cmd_clean,
+    }[args.cmd](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

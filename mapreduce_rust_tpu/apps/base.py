@@ -66,5 +66,19 @@ class App:
             lines.sort()
         return parts
 
+    def finalize_partition(self, items: Iterable, partition: int) -> list[bytes]:
+        """Egress for ONE reduce partition — the distributed (worker/) path,
+        where each reduce task owns one hash class and emits its own
+        mr-{r}.txt (reference src/mr/worker.rs:167). items as in finalize.
+        Apps needing global selection emit per-partition *candidates* here
+        and finish the job in merge_lines (top_k does)."""
+        return sorted(self.format_line(w, v) for w, v, _ in items)
+
+    def merge_lines(self, lines: Iterable[bytes]) -> list[bytes]:
+        """Global merge of all partitions' lines — the reference's
+        `cat mr-* | sort > final.txt` (src/run.sh:17-21), overridable for
+        apps whose final answer is a global selection."""
+        return sorted(lines)
+
     def format_line(self, word: bytes, value: "FinalValue") -> bytes:
         return b"%s %d" % (word, value)

@@ -35,3 +35,19 @@ class TopK(App):
         parts: dict[int, list[bytes]] = {r: [] for r in range(reduce_n)}
         parts[0] = [self.format_line(w, v) for w, v, _ in top]
         return parts
+
+    def finalize_partition(self, items: Iterable, partition: int) -> list[bytes]:
+        """Per-partition top-k *candidates*: partitions hold disjoint key
+        sets, so the global top-k is a subset of the union of per-partition
+        top-k's — the distributed combiner step."""
+        top = heapq.nsmallest(self.k, items, key=lambda it: (-it[1], it[0]))
+        return [self.format_line(w, v) for w, v, _ in top]
+
+    def merge_lines(self, lines: Iterable[bytes]) -> list[bytes]:
+        """Global selection over the candidates (the tree-reduce root)."""
+        parsed = []
+        for line in lines:
+            word, val = line.rsplit(b" ", 1)
+            parsed.append((word, int(val)))
+        top = heapq.nsmallest(self.k, parsed, key=lambda it: (-it[1], it[0]))
+        return [self.format_line(w, v) for w, v in top]

@@ -1,0 +1,9 @@
+"""Control plane: scheduler, lease failure detector, JSON-RPC server."""
+
+from mapreduce_rust_tpu.coordinator.server import (  # noqa: F401
+    DONE,
+    NOT_READY,
+    WAIT,
+    Coordinator,
+    CoordinatorClient,
+)

@@ -1,0 +1,254 @@
+"""Control-plane coordinator: scheduler + lease-based failure detector.
+
+Behavioral port of the reference coordinator (src/mr/coordinator.rs) with
+its scheduler semantics preserved exactly and its two crash bugs fixed:
+
+- 7-RPC surface (coordinator.rs:102-111): get_worker_id, get_map_task,
+  get_reduce_task, renew_{map,reduce}_lease, report_{map,reduce}_task_finish.
+- Sentinels (coordinator.rs:143,159,161): **-2** phase not ready (workers
+  missing / map unfinished), **-3** all tasks assigned but leases
+  outstanding (straggler wait), **-1** phase complete.
+- Registration barrier: no map task is issued until worker_n workers
+  registered (prepare(), coordinator.rs:42-44).
+- Leases: granting a task stamps a deadline; the detector scan expires
+  stale leases and flips the task back to unassigned for re-execution
+  (check_lease, coordinator.rs:50-97). Phase finish flips only when every
+  issued task reported, no task is pending reassignment, and the lease
+  table is empty (coordinator.rs:252-258,285-291).
+
+Bug fixes (SURVEY.md §3-D, deliberately not reproduced):
+- renew_*_lease on a lease that was just reported returns False instead of
+  panicking (reference ``assert!(contains_key)``, coordinator.rs:125,132);
+- a worker beyond worker_n gets -1 ("not needed") instead of crashing the
+  coordinator (reference assert, coordinator.rs:220).
+
+The RPC plane carries only small integers — the control/data separation
+the reference establishes by not deriving Serialize on KeyValue
+(src/lib.rs:9). Data moves through spilled partition files (worker/) or
+ICI collectives (parallel/), never through here.
+
+Transport: newline-delimited JSON-RPC over asyncio TCP — the Python
+counterpart of tarpc's Json TCP transport (src/bin/mrcoordinator.rs:31-43).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+
+from mapreduce_rust_tpu.config import Config
+
+log = logging.getLogger("mapreduce_rust_tpu.coordinator")
+
+NOT_READY = -2   # phase gate / registration barrier
+WAIT = -3        # all assigned, leases outstanding — straggler wait
+DONE = -1        # phase complete
+
+
+class _Phase:
+    """Task table of one phase: assignment flags, fresh-id counter, leases."""
+
+    def __init__(self, n: int, lease_timeout_s: float) -> None:
+        self.n = n
+        self.assigned: dict[int, bool] = {i: False for i in range(n)}
+        self.next_id = 0
+        self.finished = False
+        self.leases: dict[int, float] = {}
+        self.lease_timeout_s = lease_timeout_s
+
+    def grant(self) -> int:
+        """Next task id per the reference grant path (coordinator.rs:137-176):
+        fresh ids first, then a rescan for expired-and-reset tasks, then
+        WAIT while leases are outstanding, DONE once finished."""
+        if self.finished:
+            return DONE
+        if self.next_id < self.n:
+            tid = self.next_id
+            self.next_id += 1
+        else:
+            tid = next((i for i, a in self.assigned.items() if not a), None)
+            if tid is None:
+                return WAIT  # all assigned, leases outstanding — stragglers
+        self.assigned[tid] = True
+        self.leases[tid] = time.monotonic() + self.lease_timeout_s
+        return tid
+
+    def renew(self, tid: int) -> bool:
+        """False (not a crash) when the lease is gone — the renewal-vs-report
+        race the reference asserts on (coordinator.rs:125,132)."""
+        if tid not in self.leases:
+            return False
+        self.leases[tid] = time.monotonic() + self.lease_timeout_s
+        return True
+
+    def report_finish(self, tid: int) -> bool:
+        self.leases.pop(tid, None)
+        # Finish iff all ids issued, nothing awaiting reassignment, and no
+        # lease outstanding (coordinator.rs:252-258).
+        if (
+            self.next_id >= self.n
+            and all(self.assigned.values())
+            and not self.leases
+        ):
+            self.finished = True
+        return self.finished
+
+    def expire_stale(self) -> list[int]:
+        now = time.monotonic()
+        dead = [tid for tid, deadline in self.leases.items() if deadline <= now]
+        for tid in dead:
+            del self.leases[tid]
+            self.assigned[tid] = False  # eligible for re-grant
+        return dead
+
+
+class Coordinator:
+    """In-process scheduler state; serve() exposes it over TCP."""
+
+    def __init__(self, cfg: Config) -> None:
+        self.cfg = cfg
+        self.map = _Phase(cfg.map_n, cfg.lease_timeout_s)
+        self.reduce = _Phase(cfg.reduce_n, cfg.lease_timeout_s)
+        self.worker_count = 0
+
+    # ---- the 7 RPCs (coordinator.rs:102-111) ----
+
+    def get_worker_id(self) -> int:
+        if self.worker_count >= self.cfg.worker_n:
+            # Reference panics here (assert, coordinator.rs:220); extra
+            # workers are simply not needed.
+            return DONE
+        wid = self.worker_count
+        self.worker_count += 1
+        log.info("worker %d registered (%d/%d)", wid, self.worker_count, self.cfg.worker_n)
+        return wid
+
+    def get_map_task(self) -> int:
+        if not self.prepare():
+            return NOT_READY  # registration barrier (coordinator.rs:142-144)
+        return self.map.grant()
+
+    def get_reduce_task(self) -> int:
+        if not self.map.finished:
+            return NOT_READY  # phase gate (coordinator.rs:183-185)
+        return self.reduce.grant()
+
+    def renew_map_lease(self, tid: int) -> bool:
+        return self.map.renew(tid)
+
+    def renew_reduce_lease(self, tid: int) -> bool:
+        return self.reduce.renew(tid)
+
+    def report_map_task_finish(self, tid: int) -> bool:
+        done = self.map.report_finish(tid)
+        log.info("map %d finished (phase done=%s)", tid, done)
+        return done
+
+    def report_reduce_task_finish(self, tid: int) -> bool:
+        done = self.reduce.report_finish(tid)
+        log.info("reduce %d finished (job done=%s)", tid, done)
+        return done
+
+    # ---- in-process methods (coordinator.rs:25-97) ----
+
+    def prepare(self) -> bool:
+        return self.worker_count >= self.cfg.worker_n
+
+    def done(self) -> bool:
+        return self.map.finished and self.reduce.finished
+
+    def check_lease(self) -> None:
+        phase, name = (self.reduce, "reduce") if self.map.finished else (self.map, "map")
+        for tid in phase.expire_stale():
+            log.warning("%s task %d lease expired — rescheduling", name, tid)
+
+    # ---- transport ----
+
+    _METHODS = frozenset({
+        "get_worker_id", "get_map_task", "get_reduce_task",
+        "renew_map_lease", "renew_reduce_lease",
+        "report_map_task_finish", "report_reduce_task_finish",
+    })
+
+    async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    return
+                req = json.loads(line)
+                method = req.get("method")
+                if method not in self._METHODS:
+                    resp = {"id": req.get("id"), "error": f"unknown method {method!r}"}
+                else:
+                    result = getattr(self, method)(*req.get("params", []))
+                    resp = {"id": req.get("id"), "result": result}
+                writer.write(json.dumps(resp).encode() + b"\n")
+                await writer.drain()
+        except (ConnectionResetError, asyncio.IncompleteReadError, json.JSONDecodeError):
+            pass
+        finally:
+            writer.close()
+
+    async def serve(self) -> None:
+        """Listen + poll loop: 1 Hz done() check, detector every
+        lease_check_period_s (src/bin/mrcoordinator.rs:47-57). Returns when
+        the job completes."""
+        server = await asyncio.start_server(self._handle, self.cfg.host, self.cfg.port)
+        log.info("coordinator on %s:%d (map_n=%d reduce_n=%d worker_n=%d)",
+                 self.cfg.host, self.cfg.port, self.cfg.map_n, self.cfg.reduce_n, self.cfg.worker_n)
+        try:
+            last_check = time.monotonic()
+            while not self.done():
+                await asyncio.sleep(min(1.0, self.cfg.lease_check_period_s))
+                if time.monotonic() - last_check >= self.cfg.lease_check_period_s:
+                    self.check_lease()
+                    last_check = time.monotonic()
+            log.info("job complete — results in %s/mr-*.txt", self.cfg.output_dir)
+        finally:
+            server.close()
+            await server.wait_closed()
+
+
+class CoordinatorClient:
+    """Tiny JSON-RPC client used by workers (and tests)."""
+
+    def __init__(self, host: str, port: int) -> None:
+        self.host, self.port = host, port
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._next_id = 0
+
+    async def connect(self, retries: int = 50, delay: float = 0.1) -> None:
+        for attempt in range(retries):
+            try:
+                self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+                return
+            except OSError:
+                if attempt == retries - 1:
+                    raise
+                await asyncio.sleep(delay)
+
+    async def call(self, method: str, *params) -> int | bool:
+        assert self._writer is not None, "connect() first"
+        self._next_id += 1
+        req = {"id": self._next_id, "method": method, "params": list(params)}
+        self._writer.write(json.dumps(req).encode() + b"\n")
+        await self._writer.drain()
+        line = await self._reader.readline()
+        if not line:
+            raise ConnectionResetError("coordinator closed")
+        resp = json.loads(line)
+        if "error" in resp:
+            raise RuntimeError(resp["error"])
+        return resp["result"]
+
+    async def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            try:
+                await self._writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError, OSError):
+                pass

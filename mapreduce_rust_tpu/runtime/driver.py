@@ -134,12 +134,15 @@ class JobResult:
     output_files: list[str]
 
 
-def _iter_input_chunks(cfg: Config, inputs: Sequence[str], stats: JobStats, dictionary: Dictionary):
-    """Shared ingest: stream chunks, feeding stats + the egress dictionary."""
-    for doc_id, path in enumerate(inputs):
+def _iter_input_chunks(cfg: Config, inputs: Sequence[str], stats: JobStats,
+                       dictionary: Dictionary, doc_id_offset: int = 0):
+    """Shared ingest: stream chunks, feeding stats + the egress dictionary.
+    doc_id = position in inputs + doc_id_offset (a worker's map task passes
+    its task id so inverted_index doc ids stay global)."""
+    for i, path in enumerate(inputs):
         stats.bytes_in += os.path.getsize(path)
         with open(path, "rb") as f:
-            for chunk in chunk_stream(f, doc_id, cfg.chunk_bytes):
+            for chunk in chunk_stream(f, doc_id_offset + i, cfg.chunk_bytes):
                 dictionary.add_text(bytes(chunk.data[: chunk.nbytes]))
                 stats.chunks += 1
                 stats.forced_cuts += int(chunk.forced_cut)
@@ -147,7 +150,8 @@ def _iter_input_chunks(cfg: Config, inputs: Sequence[str], stats: JobStats, dict
                 yield chunk
 
 
-def _stream_single(cfg: Config, app: App, inputs, stats, acc, dictionary) -> None:
+def _stream_single(cfg: Config, app: App, inputs, stats, acc, dictionary,
+                   doc_id_offset: int = 0) -> None:
     device = select_device(cfg.device)
     u_cap = cfg.effective_partial_capacity()
     map_combine, merge = make_step_fns(app, u_cap)
@@ -180,7 +184,7 @@ def _stream_single(cfg: Config, app: App, inputs, stats, acc, dictionary) -> Non
             stats.spilled_keys += n
             acc.add_batch(evicted)
 
-    for chunk in _iter_input_chunks(cfg, inputs, stats, dictionary):
+    for chunk in _iter_input_chunks(cfg, inputs, stats, dictionary, doc_id_offset):
         chunk_dev = jax.device_put(chunk.data, device)
         did = jax.device_put(np.int32(chunk.doc_id), device)
         update, ovf = map_combine(chunk_dev, did)

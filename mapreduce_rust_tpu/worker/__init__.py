@@ -1,0 +1,3 @@
+"""Worker runtime: pull-based task loop, map/reduce engines, spill files."""
+
+from mapreduce_rust_tpu.worker.runtime import Worker  # noqa: F401

@@ -1,0 +1,228 @@
+"""Worker runtime: pull-based task loop + map/reduce task execution.
+
+Behavioral port of the reference worker (src/bin/mrworker.rs:43-151 loop,
+src/mr/worker.rs:65-193 engines), with the data plane swapped for this
+framework's kernels and one reference bug class fixed throughout:
+
+- Task loop: register (get_worker_id), then a two-state machine — map
+  phase until get_map_task returns -1, then reduce phase until
+  get_reduce_task returns -1, then exit (mrworker.rs:115-118). Sentinels
+  -2/-3 sleep poll_retry_s and retry (mrworker.rs:51-58).
+- Lease renewal: an asyncio task renewing every lease_renew_period_s —
+  including on the map side, fixing the reference's no-sleep busy flood
+  (mrworker.rs:87-93); a False renewal (stale) just stops the loop.
+- Map task m: stream input file m through the chunker, tokenize+combine
+  (device engine: the jitted kernels; host engine: the C-speed extract +
+  Counter path — the faithful CPU-baseline worker), partition the final
+  per-task table by k1 % reduce_n, and write one spill file per partition
+  plus a dictionary shard — the mr-{m}-{r}.txt protocol of the reference
+  (worker.rs:117-140) with npz arrays instead of text lines, written
+  temp+rename so task re-execution is atomic (the reference's
+  File::create truncation can interleave with a replacement worker,
+  SURVEY.md §3-D).
+- Reduce task r: load every map's partition-r spill, fold exactly
+  (HostAccumulator), merge dictionary shards, emit sorted lines to
+  mr-{r}.txt (worker.rs:157-193 — including the last key group, which the
+  reference drops).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import logging
+import os
+import pathlib
+import uuid
+
+import numpy as np
+
+from mapreduce_rust_tpu.apps import get_app
+from mapreduce_rust_tpu.apps.base import App
+from mapreduce_rust_tpu.config import Config
+from mapreduce_rust_tpu.coordinator.server import DONE, NOT_READY, WAIT, CoordinatorClient
+from mapreduce_rust_tpu.core.hashing import hash_words
+from mapreduce_rust_tpu.runtime.chunker import chunk_stream, list_inputs
+from mapreduce_rust_tpu.runtime.dictionary import Dictionary, extract_words
+
+log = logging.getLogger("mapreduce_rust_tpu.worker")
+
+
+def _atomic_write(path: pathlib.Path, write_fn) -> None:
+    """Write-temp-then-rename with a per-writer-unique temp name: a lease
+    straggler and its replacement can execute the same task concurrently
+    (SURVEY.md §3-D), so the temp file must never be shared."""
+    tmp = path.with_name(f".{path.name}.{os.getpid()}.{uuid.uuid4().hex[:8]}.tmp")
+    write_fn(tmp)
+    os.replace(tmp, path)
+
+
+def _atomic_savez(path: pathlib.Path, **arrays) -> None:
+    def _w(tmp: pathlib.Path) -> None:
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+
+    _atomic_write(path, _w)
+
+
+class Worker:
+    def __init__(self, cfg: Config, app: App | None = None, engine: str = "host") -> None:
+        if engine not in ("host", "device"):
+            raise ValueError(f"unknown engine {engine!r}")
+        self.cfg = cfg
+        self.app = app or get_app("word_count")
+        self.engine = engine
+        self.inputs = list_inputs(cfg.input_dir, cfg.input_pattern)
+        self.work = pathlib.Path(cfg.work_dir)
+        self.out = pathlib.Path(cfg.output_dir)
+        self.worker_id: int | None = None
+
+    # ---- map/reduce engines ----
+
+    def _map_table(self, doc_id: int, path: str) -> tuple[dict, Dictionary]:
+        """(key-pair → combined value, dictionary shard) for one input file."""
+        dictionary = Dictionary()
+        op = self.app.combine_op
+        if self.engine == "device":
+            return self._map_table_device(doc_id, path, dictionary)
+        # Host engine: the reference's exact per-task work (wc::map +
+        # combiner) at C speed — also the honest multi-process CPU baseline.
+        counts: collections.Counter = collections.Counter()
+        with open(path, "rb") as f:
+            for chunk in chunk_stream(f, doc_id, self.cfg.chunk_bytes):
+                words = extract_words(bytes(chunk.data[: chunk.nbytes]))
+                counts.update(words)
+                dictionary.add_words(words)
+        table: dict = {}
+        uniq = list(counts.keys())
+        keys = hash_words(uniq)
+        for w, (k1, k2) in zip(uniq, keys.tolist()):
+            key = (k1, k2)
+            if op == "sum":
+                table[key] = table.get(key, 0) + counts[w]
+            elif op == "distinct":
+                table.setdefault(key, set()).add(doc_id)
+            else:  # max/min of count within the task — app-defined payloads
+                table[key] = counts[w]
+        return table, dictionary
+
+    def _map_table_device(self, doc_id: int, path: str, dictionary: Dictionary):
+        from mapreduce_rust_tpu.runtime.driver import HostAccumulator, _stream_single
+        from mapreduce_rust_tpu.runtime.metrics import JobStats
+
+        acc = HostAccumulator(self.app.combine_op)
+        _stream_single(self.cfg, self.app, [path], JobStats(), acc, dictionary,
+                       doc_id_offset=doc_id)
+        return acc.table, dictionary
+
+    def run_map_task(self, tid: int) -> None:
+        path = self.inputs[tid]
+        table, dictionary = self._map_table(tid, path)
+        self.work.mkdir(parents=True, exist_ok=True)
+        op = self.app.combine_op
+        reduce_n = self.cfg.reduce_n
+        parts: dict[int, list] = {r: [] for r in range(reduce_n)}
+        for (k1, k2), v in table.items():
+            if op == "distinct":
+                for d in sorted(v):
+                    parts[k1 % reduce_n].append((k1, k2, d))
+            else:
+                parts[k1 % reduce_n].append((k1, k2, v))
+        for r, rows in parts.items():
+            arr = np.asarray(rows, dtype=np.int64).reshape(-1, 3)
+            _atomic_savez(
+                self.work / f"mr-{tid}-{r}.npz",
+                k1=arr[:, 0].astype(np.uint32),
+                k2=arr[:, 1].astype(np.uint32),
+                value=arr[:, 2].astype(np.int64),
+            )
+        # Dictionary shards are partitioned by the same k1 % reduce_n route
+        # as the spills, so reduce task r reads exactly its own words —
+        # mirroring the mr-{m}-{r} protocol (src/mr/worker.rs:121).
+        dict_parts: dict[int, Dictionary] = {r: Dictionary() for r in range(reduce_n)}
+        for (k1, k2), word in dictionary.items():
+            dict_parts[k1 % reduce_n]._word_of[(k1, k2)] = word
+        for r, dp in dict_parts.items():
+            dp.collisions = list(dictionary.collisions) if r == 0 else []
+            _atomic_write(self.work / f"dict-{tid}-{r}.txt", dp.save)
+        log.info("map %d: %s → %d keys, %d dict words", tid, path, len(table), len(dictionary))
+
+    def run_reduce_task(self, tid: int) -> None:
+        from mapreduce_rust_tpu.runtime.driver import HostAccumulator
+
+        acc = HostAccumulator(self.app.combine_op)
+        dictionary = Dictionary()
+        for m in range(len(self.inputs)):
+            spill = self.work / f"mr-{m}-{tid}.npz"
+            with np.load(spill) as z:
+                keys = np.stack([z["k1"], z["k2"]], axis=1)
+                acc.add(keys, z["value"])
+            dictionary.merge(Dictionary.load(self.work / f"dict-{m}-{tid}.txt"))
+        is_distinct = self.app.combine_op == "distinct"
+        items = []
+        for key, v in acc.table.items():
+            word = dictionary.lookup(*key)
+            if word is None:
+                continue
+            items.append((word, sorted(v) if is_distinct else v, key))
+        lines = self.app.finalize_partition(items, tid)
+        self.out.mkdir(parents=True, exist_ok=True)
+        tmp = self.out / f"mr-{tid}.txt.tmp"
+        with open(tmp, "wb") as f:
+            for line in lines:
+                f.write(line + b"\n")
+        os.replace(tmp, self.out / f"mr-{tid}.txt")
+        log.info("reduce %d: %d keys → mr-%d.txt", tid, len(items), tid)
+
+    # ---- task loop ----
+
+    async def _renewal_loop(self, client: CoordinatorClient, method: str, tid: int) -> None:
+        try:
+            while True:
+                await asyncio.sleep(self.cfg.lease_renew_period_s)
+                if not await client.call(method, tid):
+                    return  # stale lease (already reported) — just stop
+        except (asyncio.CancelledError, ConnectionResetError):
+            pass
+
+    async def _run_phase(self, client: CoordinatorClient, get: str, renew: str,
+                         report: str, run_task) -> None:
+        while True:
+            tid = await client.call(get)
+            if tid == DONE:
+                return
+            if tid in (NOT_READY, WAIT):
+                await asyncio.sleep(self.cfg.poll_retry_s)
+                continue
+            # Separate connection for renewals, like the reference's
+            # spawned renewal task (mrworker.rs:70-94) — but paced.
+            renew_client = CoordinatorClient(self.cfg.host, self.cfg.port)
+            await renew_client.connect()
+            renewal = asyncio.create_task(self._renewal_loop(renew_client, renew, tid))
+            try:
+                # Heavy compute off the event loop so renewals keep flowing.
+                await asyncio.get_running_loop().run_in_executor(None, run_task, tid)
+            finally:
+                renewal.cancel()
+                await asyncio.gather(renewal, return_exceptions=True)
+                await renew_client.close()
+            await client.call(report, tid)
+
+    async def run(self) -> None:
+        client = CoordinatorClient(self.cfg.host, self.cfg.port)
+        await client.connect()
+        try:
+            wid = await client.call("get_worker_id")
+            if wid == DONE:
+                log.info("coordinator full — exiting")
+                return
+            self.worker_id = wid
+            log.info("worker %d: map phase", wid)
+            await self._run_phase(client, "get_map_task", "renew_map_lease",
+                                  "report_map_task_finish", self.run_map_task)
+            log.info("worker %d: reduce phase", wid)
+            await self._run_phase(client, "get_reduce_task", "renew_reduce_lease",
+                                  "report_reduce_task_finish", self.run_reduce_task)
+            log.info("worker %d: done", wid)
+        finally:
+            await client.close()

@@ -1,0 +1,282 @@
+"""Control plane: scheduler semantics, sentinels, leases, fault injection.
+
+In-process asyncio (coordinator server + worker clients as tasks) with the
+host engine, so these run fast and without device compiles. Reference
+behavior: src/mr/coordinator.rs, src/bin/mrworker.rs.
+"""
+
+import asyncio
+import collections
+import pathlib
+import socket
+
+import pytest
+
+from mapreduce_rust_tpu.apps import InvertedIndex, TopK
+from mapreduce_rust_tpu.config import Config
+from mapreduce_rust_tpu.coordinator.server import (
+    DONE,
+    NOT_READY,
+    WAIT,
+    Coordinator,
+    CoordinatorClient,
+)
+from mapreduce_rust_tpu.core.normalize import reference_word_counts
+from mapreduce_rust_tpu.worker.runtime import Worker
+
+TEXTS = [
+    "the quick brown fox jumps over the lazy dog " * 30,
+    "pack my box with five dozen liquor jugs don’t stop " * 20,
+    "sphinx of black quartz judge my vow " * 25,
+]
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def make_cfg(tmp_path, n_files, **kw) -> Config:
+    defaults = dict(
+        map_n=n_files,
+        reduce_n=3,
+        worker_n=2,
+        chunk_bytes=4096,
+        port=free_port(),
+        lease_timeout_s=1.0,
+        lease_check_period_s=0.2,
+        lease_renew_period_s=0.2,
+        poll_retry_s=0.05,
+        input_dir=str(tmp_path / "in"),
+        work_dir=str(tmp_path / "work"),
+        output_dir=str(tmp_path / "out"),
+    )
+    defaults.update(kw)
+    return Config(**defaults)
+
+
+def write_corpus(tmp_path, texts=TEXTS):
+    d = tmp_path / "in"
+    d.mkdir(exist_ok=True)
+    for i, t in enumerate(texts):
+        (d / f"doc-{i}.txt").write_bytes(t.encode())
+
+
+def oracle(texts=TEXTS) -> dict:
+    total = collections.Counter()
+    for t in texts:
+        total.update(reference_word_counts(t.encode()))
+    return {w.encode(): c for w, c in total.items()}
+
+
+def read_outputs(cfg) -> dict:
+    table = {}
+    for p in sorted(pathlib.Path(cfg.output_dir).glob("mr-*.txt")):
+        for line in p.read_bytes().splitlines():
+            w, v = line.rsplit(b" ", 1)
+            table[w] = int(v)
+    return table
+
+
+# ---- scheduler unit semantics ----
+
+def test_sentinels_and_barrier(tmp_path):
+    cfg = make_cfg(tmp_path, 2, worker_n=2)
+    c = Coordinator(cfg)
+    # registration barrier: no tasks before worker_n registrations
+    assert c.get_map_task() == NOT_READY
+    assert c.get_worker_id() == 0
+    assert c.get_map_task() == NOT_READY
+    assert c.get_worker_id() == 1
+    # extra worker refused, not a panic (reference asserts, coordinator.rs:220)
+    assert c.get_worker_id() == DONE
+    # fresh ids then straggler wait
+    assert c.get_map_task() == 0
+    assert c.get_map_task() == 1
+    assert c.get_map_task() == WAIT
+    # reduce gated until map finishes (coordinator.rs:183-185)
+    assert c.get_reduce_task() == NOT_READY
+    assert not c.report_map_task_finish(0)
+    assert c.report_map_task_finish(1)
+    assert c.map.finished
+    assert c.get_map_task() == DONE
+    assert c.get_reduce_task() == 0
+
+
+def test_stale_renewal_returns_false_not_crash(tmp_path):
+    cfg = make_cfg(tmp_path, 1, worker_n=1)
+    c = Coordinator(cfg)
+    c.get_worker_id()
+    tid = c.get_map_task()
+    assert c.renew_map_lease(tid) is True
+    c.report_map_task_finish(tid)
+    # the renewal-vs-report race (coordinator.rs:125): stale renewal is a no
+    assert c.renew_map_lease(tid) is False
+
+
+def test_lease_expiry_recycles_task(tmp_path):
+    cfg = make_cfg(tmp_path, 1, worker_n=1, lease_timeout_s=0.0)
+    c = Coordinator(cfg)
+    c.get_worker_id()
+    assert c.get_map_task() == 0
+    assert c.get_map_task() == WAIT
+    c.check_lease()  # deadline passed immediately (timeout 0)
+    assert c.get_map_task() == 0  # re-granted
+    c.report_map_task_finish(0)
+    assert c.map.finished
+
+
+# ---- end-to-end over real sockets ----
+
+async def _run_cluster(cfg, n_workers, app=None, engine="host", kill_one=False):
+    coord = Coordinator(cfg)
+    serve = asyncio.create_task(coord.serve())
+    await asyncio.sleep(0.1)
+
+    async def one_worker(i):
+        w = Worker(cfg, app=app, engine=engine)
+        await w.run()
+
+    workers = [asyncio.create_task(one_worker(i)) for i in range(n_workers)]
+    if kill_one:
+        # let it claim a task, then kill it mid-flight (worker death;
+        # SURVEY.md §3-D recovery path)
+        await asyncio.sleep(0.3)
+        workers[0].cancel()
+        await asyncio.gather(workers[0], return_exceptions=True)
+        workers = workers[1:]
+    await asyncio.wait_for(asyncio.gather(*workers), timeout=60)
+    await asyncio.wait_for(serve, timeout=30)
+
+
+def test_cluster_word_count_end_to_end(tmp_path):
+    write_corpus(tmp_path)
+    cfg = make_cfg(tmp_path, len(TEXTS), worker_n=2)
+    asyncio.run(_run_cluster(cfg, 2))
+    assert read_outputs(cfg) == oracle()
+
+
+def test_cluster_survives_worker_death(tmp_path):
+    # Both workers register (worker_n=2 barrier) and claim tasks; one dies
+    # mid-task. Its lease must expire, the task re-grant to the survivor,
+    # and the job complete with exact results (SURVEY.md §3-D).
+    write_corpus(tmp_path)
+    big = "repeat me many times " * 20000  # slow task: victim dies mid-map
+    write_corpus(tmp_path, TEXTS + [big])
+    cfg = make_cfg(tmp_path, len(TEXTS) + 1, worker_n=2)
+    asyncio.run(_run_cluster(cfg, 2, kill_one=True))
+    assert read_outputs(cfg) == oracle(TEXTS + [big])
+
+
+def test_cluster_inverted_index(tmp_path):
+    write_corpus(tmp_path)
+    cfg = make_cfg(tmp_path, len(TEXTS), worker_n=2)
+    asyncio.run(_run_cluster(cfg, 2, app=InvertedIndex()))
+    want: dict = {}
+    for d, t in enumerate(TEXTS):
+        for w in reference_word_counts(t.encode()):
+            want.setdefault(w.encode(), set()).add(d)
+    got = {}
+    for p in sorted(pathlib.Path(cfg.output_dir).glob("mr-*.txt")):
+        for line in p.read_bytes().splitlines():
+            w, v = line.rsplit(b" ", 1)
+            got[w] = set(int(x) for x in v.split(b","))
+    assert got == want
+
+
+def test_cluster_top_k_candidates_then_merge(tmp_path):
+    write_corpus(tmp_path)
+    cfg = make_cfg(tmp_path, len(TEXTS), worker_n=1)
+    app = TopK(k=5)
+    asyncio.run(_run_cluster(cfg, 1, app=app))
+    lines = []
+    for p in sorted(pathlib.Path(cfg.output_dir).glob("mr-*.txt")):
+        lines.extend(p.read_bytes().splitlines())
+    top = app.merge_lines(lines)
+    want = sorted(oracle().items(), key=lambda kv: (-kv[1], kv[0]))[:5]
+    assert top == [b"%s %d" % (w, c) for w, c in want]
+
+
+def test_cluster_device_engine_inverted_index(tmp_path):
+    # Device-engine map tasks must stamp GLOBAL doc ids (task id), not 0.
+    write_corpus(tmp_path)
+    cfg = make_cfg(tmp_path, len(TEXTS), worker_n=1,
+                   merge_capacity=1 << 12, device="cpu")
+    asyncio.run(_run_cluster(cfg, 1, app=InvertedIndex(), engine="device"))
+    want: dict = {}
+    for d, t in enumerate(TEXTS):
+        for w in reference_word_counts(t.encode()):
+            want.setdefault(w.encode(), set()).add(d)
+    got = {}
+    for p in sorted(pathlib.Path(cfg.output_dir).glob("mr-*.txt")):
+        for line in p.read_bytes().splitlines():
+            w, v = line.rsplit(b" ", 1)
+            got[w] = set(int(x) for x in v.split(b","))
+    assert got == want
+
+
+def test_cli_run_single_process(tmp_path, capsys):
+    write_corpus(tmp_path)
+    from mapreduce_rust_tpu.__main__ import main
+
+    rc = main([
+        "run", "--input", str(tmp_path / "in"), "--output", str(tmp_path / "out"),
+        "--chunk-mb", "0.01", "--device", "cpu", "--reduce-n", "3",
+    ])
+    assert rc == 0
+    cfg = make_cfg(tmp_path, len(TEXTS))
+    assert read_outputs(cfg) == oracle()
+
+
+def test_cli_coordinator_worker_subprocesses(tmp_path):
+    """The README quickstart, literally: coordinator + 2 workers as OS
+    processes over TCP (reference src/bin/* usage)."""
+    import subprocess
+    import sys
+
+    write_corpus(tmp_path)
+    port = str(free_port())
+    common = [
+        "--input", str(tmp_path / "in"), "--output", str(tmp_path / "out"),
+        "--work", str(tmp_path / "work"), "--port", port, "--reduce-n", "3",
+    ]
+    repo_root = str(pathlib.Path(__file__).resolve().parent.parent)
+    env = {"PYTHONPATH": repo_root, "PATH": "/usr/bin:/bin"}
+    coord = subprocess.Popen(
+        [sys.executable, "-m", "mapreduce_rust_tpu", "coordinator", "--worker-n", "2", *common],
+        env=env,
+    )
+    workers = [
+        subprocess.Popen(
+            [sys.executable, "-m", "mapreduce_rust_tpu", "worker", "--engine", "host", *common],
+            env=env,
+        )
+        for _ in range(2)
+    ]
+    try:
+        for w in workers:
+            assert w.wait(timeout=60) == 0
+        assert coord.wait(timeout=30) == 0
+    finally:
+        for p in [coord, *workers]:
+            if p.poll() is None:
+                p.kill()
+    cfg = make_cfg(tmp_path, len(TEXTS))
+    assert read_outputs(cfg) == oracle()
+
+
+def test_cli_merge_and_clean(tmp_path):
+    write_corpus(tmp_path)
+    cfg = make_cfg(tmp_path, len(TEXTS), worker_n=1)
+    asyncio.run(_run_cluster(cfg, 1))
+    from mapreduce_rust_tpu.__main__ import main
+
+    rc = main(["merge", "--output", cfg.output_dir])
+    assert rc == 0
+    final = (pathlib.Path(cfg.output_dir) / "final.txt").read_bytes().splitlines()
+    assert len(final) == len(oracle()) and final == sorted(final)
+    rc = main(["clean", "--output", cfg.output_dir, "--work", cfg.work_dir])
+    assert rc == 0
+    assert not list(pathlib.Path(cfg.output_dir).glob("mr-*.txt"))
+    assert not list(pathlib.Path(cfg.work_dir).glob("mr-*.npz"))
