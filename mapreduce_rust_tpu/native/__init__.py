@@ -1,0 +1,4 @@
+"""Native host tier: C++ one-pass tokenize/dedupe/hash scanner (loader.cpp)
+bridged via ctypes (host.py) with a pure-Python fallback."""
+
+from mapreduce_rust_tpu.native.host import get_lib, scan_unique  # noqa: F401

@@ -1,0 +1,103 @@
+"""ctypes bridge to the native host scanner (loader.cpp).
+
+Builds the shared object on first use with g++ (no pybind11 in this image;
+the C ABI + ctypes keeps the binding dependency-free), caches it next to
+the source with an mtime check, and degrades gracefully: if the toolchain
+or compile is unavailable, callers fall back to the pure-Python path
+(runtime/dictionary.py works either way — tests cover both).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import pathlib
+import subprocess
+import threading
+
+import numpy as np
+
+log = logging.getLogger("mapreduce_rust_tpu.native")
+
+_SRC = pathlib.Path(__file__).with_name("loader.cpp")
+_SO = pathlib.Path(__file__).with_name("_mrnative.so")
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        if _SO.exists() and _SO.stat().st_mtime >= _SRC.stat().st_mtime:
+            return True
+        # Compile to a per-process temp then atomically rename: concurrent
+        # workers (README quickstart spawns several) must never observe a
+        # half-written .so.
+        tmp = _SO.with_name(f".{_SO.name}.{os.getpid()}.tmp")
+        cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+               "-o", str(tmp), str(_SRC)]
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        log.warning("native build unavailable (%s) — using Python fallback", e)
+        return False
+
+
+def get_lib() -> ctypes.CDLL | None:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        try:
+            if not _build():
+                return None
+            lib = ctypes.CDLL(str(_SO))
+            lib.mr_scan_unique.restype = ctypes.c_int64
+            lib.mr_scan_unique.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint32),
+                ctypes.c_int64,
+            ]
+        except OSError as e:
+            log.warning("native load failed (%s) — using Python fallback", e)
+            return None
+        _lib = lib
+        return _lib
+
+
+def scan_unique(data: bytes) -> tuple[list[bytes], np.ndarray] | None:
+    """(unique cleaned words, uint32[n,2] hash pairs) — or None if the
+    native path is unavailable. One C pass: tokenize, dedupe, hash."""
+    lib = get_lib()
+    if lib is None or not data:
+        return ([], np.empty((0, 2), dtype=np.uint32)) if lib and not data else None
+    n = len(data)
+    max_words = n // 2 + 2
+    words_buf = np.empty(n + 1, dtype=np.uint8)
+    ends = np.empty(max_words, dtype=np.int64)
+    k1 = np.empty(max_words, dtype=np.uint32)
+    k2 = np.empty(max_words, dtype=np.uint32)
+    count = lib.mr_scan_unique(
+        data, n,
+        words_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        ends.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        k1.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        k2.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        max_words,
+    )
+    if count < 0:  # cannot happen with max_words = n//2+2; belt and braces
+        return None
+    count = int(count)
+    raw = words_buf[: int(ends[count - 1])].tobytes() if count else b""
+    words = []
+    start = 0
+    for i in range(count):
+        end = int(ends[i])
+        words.append(raw[start:end])
+        start = end
+    keys = np.stack([k1[:count], k2[:count]], axis=1)
+    return words, keys

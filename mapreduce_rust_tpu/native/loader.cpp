@@ -1,0 +1,147 @@
+// Native host scanner: tokenize + dedupe + hash one normalized byte chunk
+// in a single pass. This is the ingest-side host hot loop — the dictionary
+// build (runtime/dictionary.py) — which otherwise costs three C-level
+// passes plus Python set churn per chunk (translate, split, set()).
+//
+// The reference's equivalent work is wc::map's regex strip + split
+// (/root/reference/src/app/wc.rs:6-13) plus DefaultHasher per pair
+// (src/mr/worker.rs:111-115) — per-record, per-task, in Rust. Here one
+// C++ pass per chunk feeds the egress dictionary while the TPU does the
+// counting; the byte classes and the two polynomial hash lanes MUST match
+// core/hashing.py exactly (tests/test_native.py proves it).
+//
+// Exposed via a C ABI for ctypes (no pybind11 in this image — see
+// native/host.py).
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t H1_MULT = 0x01000193u;  // FNV-1a prime
+constexpr uint32_t H1_INIT = 0x811C9DC5u;  // FNV offset basis
+constexpr uint32_t H2_MULT = 1000003u;     // CPython string-hash prime
+constexpr uint32_t H2_INIT = 0x9E3779B9u;  // golden ratio
+
+// Byte classes (core/hashing.byte_class_tables): 0 = delete (ASCII
+// punctuation), 1 = word char, 2 = whitespace.
+struct Tables {
+  uint8_t cls[256];
+  Tables() {
+    for (int b = 0; b < 256; ++b) cls[b] = 0;
+    const char* ws = " \t\n\r\v\f";
+    for (const char* p = ws; *p; ++p) cls[(uint8_t)*p] = 2;
+    for (int b = 'a'; b <= 'z'; ++b) cls[b] = 1;
+    for (int b = 'A'; b <= 'Z'; ++b) cls[b] = 1;
+    for (int b = '0'; b <= '9'; ++b) cls[b] = 1;
+    cls[(uint8_t)'_'] = 1;
+    for (int b = 0x80; b < 256; ++b) cls[b] = 1;  // UTF-8 stays in words
+  }
+};
+const Tables kTables;
+
+struct Slot {
+  uint32_t k1, k2;
+  int64_t off;   // offset into words_out
+  int32_t len;
+  int32_t used;
+};
+
+}  // namespace
+
+extern "C" {
+
+// Scan [buf, buf+len): tokenize on whitespace, delete punctuation inside
+// tokens, hash the cleaned word with both lanes, deduplicate EXACTLY (hash
+// pair + bytes; two different words with equal pairs stay distinct so the
+// Python side can detect the collision). Outputs:
+//   words_out  — cleaned unique words, concatenated (capacity >= len)
+//   ends_out   — exclusive end offset of word i in words_out
+//   k1/k2_out  — hash lanes of word i
+// Returns the number of unique words, or -1 if max_words was too small.
+int64_t mr_scan_unique(const uint8_t* buf, int64_t len,
+                       uint8_t* words_out, int64_t* ends_out,
+                       uint32_t* k1_out, uint32_t* k2_out,
+                       int64_t max_words) {
+  // Open addressing with growth: start small (typical chunks have ~1
+  // unique per 30 bytes), rehash at 70% load so the probe loop always has
+  // empty slots — a table that fills completely would otherwise spin
+  // forever on the first non-duplicate probe.
+  int64_t cap = 1024;
+  while (cap < (len / 16 + 16)) cap <<= 1;
+  std::vector<Slot> table((size_t)cap);
+  std::memset(table.data(), 0, sizeof(Slot) * (size_t)cap);
+
+  std::vector<uint8_t> word;
+  word.reserve(256);
+  int64_t n_unique = 0;
+  int64_t words_len = 0;
+
+  auto grow = [&]() {
+    int64_t ncap = cap << 1;
+    std::vector<Slot> ntab((size_t)ncap);
+    std::memset(ntab.data(), 0, sizeof(Slot) * (size_t)ncap);
+    uint64_t nmask = (uint64_t)ncap - 1;
+    for (int64_t j = 0; j < cap; ++j) {
+      const Slot& s = table[j];
+      if (!s.used) continue;
+      uint64_t i = (((uint64_t)s.k1 << 32) | s.k2) & nmask;
+      while (ntab[i].used) i = (i + 1) & nmask;
+      ntab[i] = s;
+    }
+    table.swap(ntab);
+    cap = ncap;
+  };
+
+  auto flush = [&]() -> bool {
+    if (word.empty()) return true;
+    uint32_t h1 = H1_INIT, h2 = H2_INIT;
+    for (uint8_t b : word) {
+      h1 = h1 * H1_MULT + b + 1;
+      h2 = h2 * H2_MULT + b + 1;
+    }
+    if (n_unique * 10 >= cap * 7) grow();  // keep load factor < 0.7
+    uint64_t mask = (uint64_t)cap - 1;
+    uint64_t i = (((uint64_t)h1 << 32) | h2) & mask;
+    for (;;) {
+      Slot& s = table[i];
+      if (!s.used) {
+        if (n_unique >= max_words) return false;
+        s.used = 1;
+        s.k1 = h1;
+        s.k2 = h2;
+        s.off = words_len;
+        s.len = (int32_t)word.size();
+        std::memcpy(words_out + words_len, word.data(), word.size());
+        words_len += (int64_t)word.size();
+        ends_out[n_unique] = words_len;
+        k1_out[n_unique] = h1;
+        k2_out[n_unique] = h2;
+        ++n_unique;
+        break;
+      }
+      if (s.k1 == h1 && s.k2 == h2 && s.len == (int32_t)word.size() &&
+          std::memcmp(words_out + s.off, word.data(), word.size()) == 0) {
+        break;  // duplicate
+      }
+      i = (i + 1) & mask;  // probe on (true collision or different word)
+    }
+    word.clear();
+    return true;
+  };
+
+  for (int64_t p = 0; p < len; ++p) {
+    uint8_t c = buf[p];
+    uint8_t cls = kTables.cls[c];
+    if (cls == 2) {
+      if (!flush()) return -1;
+    } else if (cls == 1) {
+      word.push_back(c);
+    }  // cls == 0: punctuation — deleted, does not split the token
+  }
+  if (!flush()) return -1;
+  return n_unique;
+}
+
+}  // extern "C"

@@ -57,20 +57,19 @@ def _scan_combine(x, y):
     return f, m1, a1, m2, a2, c
 
 
-@functools.partial(jax.jit, static_argnames=("last_is_boundary",))
-def tokenize_and_hash(chunk: jnp.ndarray, last_is_boundary: bool = True) -> KVBatch:
-    """Tokenize+hash one uint8 byte chunk.
+def _scan_combine_len(x, y):
+    """_scan_combine plus a token-byte-length lane (resets at whitespace,
+    +1 per non-ws byte incl. deleted punctuation) — the halo-exchange path
+    uses it to detect tokens that began before the halo window
+    (parallel/halo.py)."""
+    *hx, lx = x
+    *hy, ly = y
+    out = _scan_combine(tuple(hx), tuple(hy))
+    fy = y[0]
+    return (*out, jnp.where(fy, ly, lx + ly))
 
-    Args:
-      chunk: uint8[N] byte array. Host chunker pads with spaces, so padding
-        never produces tokens.
-      last_is_boundary: whether byte N-1 ends the stream (True for
-        whitespace-aligned chunks; False when a halo from the right
-        neighbor follows — see parallel/halo.py).
 
-    Returns a KVBatch[N]: valid entries sit at token-end byte positions
-    with value 1 (one occurrence).
-    """
+def _tokenize(chunk: jnp.ndarray, last_is_boundary: bool, with_len: bool):
     ws_tab, wc_tab = byte_class_tables()
     idx = chunk.astype(jnp.int32)
     is_ws = jnp.take(jnp.asarray(ws_tab), idx).astype(bool)
@@ -85,9 +84,16 @@ def tokenize_and_hash(chunk: jnp.ndarray, last_is_boundary: bool = True) -> KVBa
     a2 = jnp.where(is_wc, cplus1, zero)
     cnt = is_wc.astype(jnp.int32)
 
-    _, m1s, a1s, m2s, a2s, cnts = jax.lax.associative_scan(
-        _scan_combine, (is_ws, m1, a1, m2, a2, cnt)
-    )
+    if with_len:
+        blen = (~is_ws).astype(jnp.int32)
+        _, m1s, a1s, m2s, a2s, cnts, tlen = jax.lax.associative_scan(
+            _scan_combine_len, (is_ws, m1, a1, m2, a2, cnt, blen)
+        )
+    else:
+        _, m1s, a1s, m2s, a2s, cnts = jax.lax.associative_scan(
+            _scan_combine, (is_ws, m1, a1, m2, a2, cnt)
+        )
+        tlen = None
     h1 = jnp.uint32(H1_INIT) * m1s + a1s
     h2 = jnp.uint32(H2_INIT) * m2s + a2s
 
@@ -98,12 +104,39 @@ def tokenize_and_hash(chunk: jnp.ndarray, last_is_boundary: bool = True) -> KVBa
     valid = is_end & (cnts > 0)
 
     sent = jnp.uint32(SENTINEL)
-    return KVBatch(
+    kv = KVBatch(
         k1=jnp.where(valid, h1, sent),
         k2=jnp.where(valid, h2, sent),
         value=valid.astype(jnp.int32),
         valid=valid,
     )
+    return kv, tlen
+
+
+@functools.partial(jax.jit, static_argnames=("last_is_boundary",))
+def tokenize_and_hash(chunk: jnp.ndarray, last_is_boundary: bool = True) -> KVBatch:
+    """Tokenize+hash one uint8 byte chunk.
+
+    Args:
+      chunk: uint8[N] byte array. Host chunker pads with spaces, so padding
+        never produces tokens.
+      last_is_boundary: whether byte N-1 ends the stream (True for
+        whitespace-aligned chunks; False when a halo from the right
+        neighbor follows — see parallel/halo.py).
+
+    Returns a KVBatch[N]: valid entries sit at token-end byte positions
+    with value 1 (one occurrence).
+    """
+    kv, _ = _tokenize(chunk, last_is_boundary, with_len=False)
+    return kv
+
+
+def tokenize_and_hash_with_len(chunk: jnp.ndarray, last_is_boundary: bool = True):
+    """(KVBatch[N], token_byte_len int32[N]) — length at a token's end byte
+    is the whole token's byte count (incl. deleted punctuation), which the
+    halo path compares against the window position to detect tokens longer
+    than the halo (parallel/halo.py). Trace-time only (call under jit)."""
+    return _tokenize(chunk, last_is_boundary, with_len=True)
 
 
 def tokenize_reference_host(data: bytes) -> dict[tuple[int, int], int]:

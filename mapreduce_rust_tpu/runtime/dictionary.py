@@ -68,22 +68,16 @@ class Dictionary:
     def lookup(self, k1: int, k2: int) -> bytes | None:
         return self._word_of.get((k1, k2))
 
-    def add_words(self, words: Iterable[bytes]) -> int:
-        """Insert unseen words; returns the number of new entries.
-
-        Dedup is C-speed set algebra (set() + difference), not a per-token
-        Python loop — this runs once per chunk on the ingest hot path,
-        overlapped with device compute.
-        """
-        fresh_set = set(words) - self._seen
-        if not fresh_set:
-            return 0
-        self._seen |= fresh_set
-        fresh = list(fresh_set)
-        keys = hash_words(fresh)
+    def _insert_hashed(self, words, keys) -> int:
+        """Single insert/collision-detection path shared by the Python and
+        native ingest branches (first word wins; differing word on an
+        existing pair is a recorded collision)."""
         added = 0
-        word_of = self._word_of
-        for (k1, k2), w in zip(keys.tolist(), fresh):
+        seen, word_of = self._seen, self._word_of
+        for w, (k1, k2) in zip(words, keys.tolist()):
+            if w in seen:
+                continue
+            seen.add(w)
             key = (k1, k2)
             prev = word_of.get(key)
             if prev is None:
@@ -93,8 +87,29 @@ class Dictionary:
                 self.collisions.append((prev, w))
         return added
 
+    def add_words(self, words: Iterable[bytes]) -> int:
+        """Insert unseen words; returns the number of new entries.
+
+        Dedup is C-speed set algebra (set() + difference), not a per-token
+        Python loop — this runs once per chunk on the ingest hot path,
+        overlapped with device compute.
+        """
+        fresh = list(set(words) - self._seen)
+        if not fresh:
+            return 0
+        return self._insert_hashed(fresh, hash_words(fresh))
+
     def add_text(self, normalized: bytes) -> int:
-        return self.add_words(extract_words(normalized))
+        """Ingest one normalized chunk. Prefers the one-pass native scanner
+        (native/loader.cpp: tokenize+dedupe+hash in C++); falls back to the
+        pure-Python three-pass path when the toolchain is unavailable."""
+        from mapreduce_rust_tpu.native.host import scan_unique
+
+        res = scan_unique(normalized)
+        if res is None:
+            return self.add_words(extract_words(normalized))
+        words, keys = res
+        return self._insert_hashed(words, keys)
 
     def items(self) -> Iterator[tuple[tuple[int, int], bytes]]:
         return iter(self._word_of.items())

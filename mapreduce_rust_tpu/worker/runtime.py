@@ -188,7 +188,13 @@ class Worker:
     async def _run_phase(self, client: CoordinatorClient, get: str, renew: str,
                          report: str, run_task) -> None:
         while True:
-            tid = await client.call(get)
+            try:
+                tid = await client.call(get)
+            except (ConnectionResetError, BrokenPipeError, OSError):
+                # Coordinator exited between our WAIT poll and this call —
+                # the job completed while we slept. A clean end, not a crash.
+                log.info("coordinator gone — assuming job complete")
+                return
             if tid == DONE:
                 return
             if tid in (NOT_READY, WAIT):

@@ -1,0 +1,89 @@
+"""Halo exchange: straddling words count once with correct hashes,
+invariant to shard size/alignment; truncation guard fires when halo < token."""
+
+import collections
+
+import numpy as np
+import pytest
+
+from mapreduce_rust_tpu.core.normalize import normalize_unicode
+from mapreduce_rust_tpu.ops.tokenize import tokenize_reference_host
+from mapreduce_rust_tpu.parallel.halo import make_sharded_tokenizer, shard_stream
+from mapreduce_rust_tpu.parallel.shuffle import make_mesh
+
+
+def sharded_counts(data: bytes, d: int, halo: int, pad: int | None = None) -> dict:
+    mesh = make_mesh(d, "cpu")
+    fn = make_sharded_tokenizer(mesh, halo)
+    shards = shard_stream(data, mesh, pad)
+    kv, trunc = fn(shards)
+    assert int(np.sum(np.asarray(trunc))) == 0
+    counts: dict = collections.defaultdict(int)
+    k1 = np.asarray(kv.k1).ravel()
+    k2 = np.asarray(kv.k2).ravel()
+    ok = np.asarray(kv.valid).ravel()
+    for a, b in zip(k1[ok].tolist(), k2[ok].tolist()):
+        counts[(a, b)] += 1
+    return dict(counts)
+
+
+TEXT = (b"alpha bravo charlie delta echo foxtrot golf hotel india juliet "
+        b"kilo lima mike november oscar papa quebec romeo sierra tango ") * 8
+
+
+@pytest.mark.parametrize("d", [2, 4, 8])
+def test_counts_match_oracle_any_shard_count(d):
+    oracle = tokenize_reference_host(TEXT)
+    assert sharded_counts(TEXT, d, halo=32) == oracle
+
+
+def test_word_straddles_known_boundary():
+    # d=2, shard width 64: the word occupies bytes 51..64 — straddling the
+    # one shard edge — and must hash whole via the left halo.
+    data = b"l" * 50 + b" " + b"straddlingword" + b" " + b"r" * 40
+    oracle = tokenize_reference_host(data)
+    got = sharded_counts(data, 2, halo=32, pad=64)
+    assert got == oracle
+    from mapreduce_rust_tpu.core.hashing import hash_word
+
+    assert got[hash_word(b"straddlingword")] == 1
+
+
+def test_word_straddles_every_boundary():
+    # 65-byte repeating unit vs shard widths that place edges mid-word.
+    word = b"straddlingword"
+    data = (b"x " * 25 + word + b" ") * 20
+    oracle = tokenize_reference_host(data)
+    for d in (2, 4, 8):
+        base = -(-len(data) // d)
+        for delta in (0, 3, 7):
+            assert sharded_counts(data, d, halo=32, pad=base + delta) == oracle
+
+
+def test_alignment_invariance():
+    # Same text, different shard widths → identical counts.
+    oracle = tokenize_reference_host(TEXT)
+    base = -(-len(TEXT) // 4)
+    for delta in (0, 1, 13, 64):
+        assert sharded_counts(TEXT, 4, halo=32, pad=base + delta) == oracle
+
+
+def test_unicode_normalized_stream():
+    raw = "naïve café — don’t “stop” straddle ".encode() * 30
+    norm = normalize_unicode(raw)
+    oracle = tokenize_reference_host(norm)
+    assert sharded_counts(norm, 4, halo=32) == oracle
+
+
+def test_truncation_guard_fires():
+    mesh = make_mesh(4, "cpu")
+    fn = make_sharded_tokenizer(mesh, halo=8)
+    data = b"a " + b"y" * 40 + b" b c d e f g h i j k l m n o p q r s t"
+    shards = shard_stream(data, mesh, pad=32)  # 40-byte token spans shards
+    _, trunc = fn(shards)
+    assert int(np.sum(np.asarray(trunc))) > 0
+
+
+def test_empty_and_all_space_shards():
+    assert sharded_counts(b"", 4, halo=16, pad=32) == {}
+    assert sharded_counts(b"   \n\t  ", 8, halo=16, pad=32) == {}

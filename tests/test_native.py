@@ -1,0 +1,77 @@
+"""Native scanner parity: identical words + hashes to the Python oracle."""
+
+import pathlib
+
+import numpy as np
+import pytest
+
+from mapreduce_rust_tpu.core.hashing import hash_words, tokenize_host
+from mapreduce_rust_tpu.core.normalize import normalize_unicode
+from mapreduce_rust_tpu.native.host import get_lib, scan_unique
+from mapreduce_rust_tpu.runtime.dictionary import Dictionary, extract_words
+
+CORPUS = pathlib.Path("/root/reference/src/data")
+
+pytestmark = pytest.mark.skipif(get_lib() is None, reason="no native toolchain")
+
+
+def oracle_unique(data: bytes):
+    seen, words = set(), []
+    for w in extract_words(data):
+        if w not in seen:
+            seen.add(w)
+            words.append(w)
+    return words, hash_words(words)
+
+
+@pytest.mark.parametrize("text", [
+    b"",
+    b"hello",
+    b"the cat sat on the mat the cat",
+    b"don't-stop ... !!! -- foo_bar42 a b c a",
+    b"  leading and trailing   ",
+    "naïve café — don’t “stop”".encode(),  # raw utf-8 (pre-normalization)
+])
+def test_scan_unique_matches_oracle(text):
+    norm = normalize_unicode(text)
+    got = scan_unique(norm)
+    assert got is not None
+    words, keys = got
+    owords, okeys = oracle_unique(norm)
+    assert words == owords
+    assert np.array_equal(keys, okeys)
+
+
+def test_scan_unique_real_corpus():
+    raw = (CORPUS / "gut-2.txt").read_bytes() if CORPUS.exists() else (
+        b"the quick brown fox lorem ipsum " * 4000
+    )
+    norm = normalize_unicode(raw)
+    words, keys = scan_unique(norm)
+    owords, okeys = oracle_unique(norm)
+    assert words == owords and np.array_equal(keys, okeys)
+
+
+def test_dense_vocabulary_no_hang():
+    # 4097+ distinct 2-byte words once filled the fixed-size table and made
+    # the probe loop spin forever (review r2); growth must handle it.
+    words = [b"%c%c" % (a, b) for a in range(ord("a"), ord("z") + 1)
+             for b in range(ord("a"), ord("z") + 1)]
+    words += [b"%c%c%c" % (a, b, c) for a in range(ord("a"), ord("k"))
+              for b in range(ord("a"), ord("z") + 1) for c in range(ord("a"), ord("z") + 1)]
+    data = b" ".join(words)
+    got_words, got_keys = scan_unique(data)
+    assert got_words == words
+    assert np.array_equal(got_keys, hash_words(words))
+
+
+def test_dictionary_native_equals_python_path(monkeypatch):
+    text = normalize_unicode("repeat repeat unique naïve don’t x_1 ".encode() * 50)
+    d_native = Dictionary()
+    d_native.add_text(text)
+    import mapreduce_rust_tpu.native.host as host
+    monkeypatch.setattr(host, "scan_unique", lambda data: None)
+    d_python = Dictionary()
+    d_python.add_text(text)
+    assert dict(d_native.items()) == dict(d_python.items())
+    assert len(d_native) == len(d_python) > 0
