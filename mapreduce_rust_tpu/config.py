@@ -33,6 +33,8 @@ class Config:
     bucket_capacity_factor: float = 2.0  # all_to_all per-bucket slack
     device: str = "auto"            # "auto" | "tpu" | "cpu"
     mesh_shape: Optional[int] = None  # devices in the 1-D mesh (None = all)
+    ingest_threads: int = 4         # host threads for dictionary scans
+    prefetch_chunks: int = 8        # chunker read-ahead depth (host queue)
 
     # ---- Control plane (reference timings preserved) ----
     host: str = "127.0.0.1"

@@ -58,17 +58,8 @@ def _classify(cp: int) -> int | None:
     return -1
 
 
-def normalize_unicode(data: bytes) -> bytes:
-    """Normalize a UTF-8 byte string for the device tokenizer.
-
-    Pure-ASCII input is returned unchanged (fast path). Otherwise the text
-    is decoded, every distinct non-ASCII codepoint is classified once, and a
-    C-speed ``str.translate`` applies keep/space/delete in one pass.
-    """
-    if data.isascii():
-        return data
-    text = data.decode("utf-8", errors="replace")
-    # Unique codepoints via the fixed-width UTF-32 view (C speed).
+def _normalize_text(text: str) -> bytes:
+    """Classify-and-translate a decoded string (the full slow path)."""
     cps = np.unique(np.frombuffer(text.encode("utf-32-le"), dtype=np.uint32))
     table: dict[int, int | str | None] = {}
     for cp in cps[cps >= 0x80].tolist():
@@ -76,8 +67,62 @@ def normalize_unicode(data: bytes) -> bytes:
         if cls is not None:
             table[cp] = "" if cls == -1 else " "
     if not table:
-        return data
+        return text.encode("utf-8")
     return text.translate(table).encode("utf-8")
+
+
+@functools.lru_cache(maxsize=65536)
+def _normalize_run(run: bytes) -> bytes:
+    """Normalize one short contiguous non-ASCII byte run. Real text repeats
+    a handful of sequences (curly quotes, dashes, accented letters), so the
+    cache turns per-run work into a dict hit."""
+    return _normalize_text(run.decode("utf-8", errors="replace"))
+
+
+_RUN_CACHE_MAX_LEN = 64
+
+
+def normalize_unicode(data: bytes) -> bytes:
+    """Normalize a UTF-8 byte string for the device tokenizer.
+
+    Pure-ASCII input is returned unchanged. Otherwise only the contiguous
+    non-ASCII byte runs are rewritten (UTF-8 lead AND continuation bytes
+    are all >= 0x80, so a run always covers whole sequences); the ASCII
+    spans between them — the overwhelming majority of real corpora — are
+    passed through by slicing at memcpy speed. Short runs hit an LRU cache;
+    pathological long runs (dense non-Latin text) fall back to the full
+    decode+translate pass per run.
+    """
+    if data.isascii():
+        return data
+    from mapreduce_rust_tpu.native.host import normalize_native
+
+    native = normalize_native(data)
+    if native is not None:
+        return native
+    return _normalize_python(data)
+
+
+def _normalize_python(data: bytes) -> bytes:
+    """The pure-Python normalization pass (fallback + native parity oracle)."""
+    arr = np.frombuffer(data, dtype=np.uint8)
+    idx = np.flatnonzero(arr >= 0x80)
+    # Split the non-ASCII byte positions into contiguous runs.
+    breaks = np.flatnonzero(np.diff(idx) > 1) + 1
+    starts = np.concatenate([idx[:1], idx[breaks]])
+    ends = np.concatenate([idx[breaks - 1] + 1, idx[-1:] + 1])
+    parts: list[bytes] = []
+    pos = 0
+    for s, e in zip(starts.tolist(), ends.tolist()):
+        parts.append(data[pos:s])
+        run = data[s:e]
+        if e - s <= _RUN_CACHE_MAX_LEN:
+            parts.append(_normalize_run(run))
+        else:
+            parts.append(_normalize_text(run.decode("utf-8", errors="replace")))
+        pos = e
+    parts.append(data[pos:])
+    return b"".join(parts)
 
 
 def reference_word_counts(data: bytes):

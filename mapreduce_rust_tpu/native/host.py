@@ -14,7 +14,7 @@ import logging
 import os
 import pathlib
 import subprocess
-import threading
+import threading  # noqa: F401 — thread-local scratch + build lock
 
 import numpy as np
 
@@ -62,6 +62,11 @@ def get_lib() -> ctypes.CDLL | None:
                 ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint32),
                 ctypes.c_int64,
             ]
+            lib.mr_normalize.restype = ctypes.c_int64
+            lib.mr_normalize.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint8),
+            ]
         except OSError as e:
             log.warning("native load failed (%s) — using Python fallback", e)
             return None
@@ -69,18 +74,95 @@ def get_lib() -> ctypes.CDLL | None:
         return _lib
 
 
-def scan_unique(data: bytes) -> tuple[list[bytes], np.ndarray] | None:
-    """(unique cleaned words, uint32[n,2] hash pairs) — or None if the
-    native path is unavailable. One C pass: tokenize, dedupe, hash."""
+_CPCLASS_CACHE = pathlib.Path(__file__).with_name("_cpclass.npz")
+_cpclass_arr: np.ndarray | None = None
+
+
+def _cpclass() -> np.ndarray:
+    """uint8[0x110000] codepoint classes (0 delete / 1 word / 2 space),
+    built ONCE from the exact rules core/normalize.py uses (re \\w + str
+    .isspace) and cached on disk — the C normalizer is table-driven so its
+    semantics are definitionally identical to the Python path."""
+    global _cpclass_arr
+    if _cpclass_arr is not None:
+        return _cpclass_arr
+    import unicodedata
+
+    fingerprint = unicodedata.unidata_version  # rebuild on Unicode-table change
+    if _CPCLASS_CACHE.exists():
+        try:
+            with np.load(_CPCLASS_CACHE) as z:
+                if str(z["unidata"]) == fingerprint:
+                    _cpclass_arr = np.ascontiguousarray(z["cls"], dtype=np.uint8)
+                    return _cpclass_arr
+        except (OSError, KeyError, ValueError):
+            pass  # corrupt/old cache — rebuild below
+    import re
+
+    cls = np.zeros(0x110000, dtype=np.uint8)
+    everything = "".join(map(chr, range(0x80, 0x110000)))
+    for ch in re.findall(r"\w", everything, re.UNICODE):
+        cls[ord(ch)] = 1
+    for i, ch in enumerate(everything):
+        if cls[i + 0x80] == 0 and ch.isspace():
+            cls[i + 0x80] = 2
+    _cpclass_arr = cls
+    try:
+        tmp = _CPCLASS_CACHE.with_name(f".cpclass.{os.getpid()}.tmp")
+        np.savez_compressed(tmp, cls=cls, unidata=fingerprint)
+        os.replace(tmp, _CPCLASS_CACHE)
+    except OSError:
+        pass
+    return _cpclass_arr
+
+
+def normalize_native(data: bytes) -> bytes | None:
+    """One-pass C normalization of raw UTF-8 (byte-exact vs the Python
+    path; tests/test_native.py), or None when the native lib is absent."""
     lib = get_lib()
-    if lib is None or not data:
-        return ([], np.empty((0, 2), dtype=np.uint32)) if lib and not data else None
+    if lib is None:
+        return None
+    out = np.empty(max(len(data), 1), dtype=np.uint8)
+    n = lib.mr_normalize(
+        data, len(data),
+        _cpclass().ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    return out[: int(n)].tobytes()
+
+
+_scratch = threading.local()
+
+
+def _buffers(n: int, max_words: int):
+    """Per-thread reusable scratch (allocating ~10 MB of numpy buffers per
+    call costs ~40% of the scan; scan results are copied out before the
+    next call on the same thread can overwrite them)."""
+    bufs = getattr(_scratch, "bufs", None)
+    if bufs is None or bufs[0].size < n + 1 or bufs[1].size < max_words:
+        bufs = (
+            np.empty(max(n + 1, 1 << 20), dtype=np.uint8),
+            np.empty(max(max_words, 1 << 18), dtype=np.int64),
+            np.empty(max(max_words, 1 << 18), dtype=np.uint32),
+            np.empty(max(max_words, 1 << 18), dtype=np.uint32),
+        )
+        _scratch.bufs = bufs
+    return bufs
+
+
+def scan_unique_raw(data: bytes) -> tuple[bytes, np.ndarray, np.ndarray] | None:
+    """(concatenated unique words, int64[n] exclusive end offsets,
+    uint32[n,2] hash pairs) — or None when the native lib is unavailable.
+    One C pass: tokenize, dedupe, hash. The caller slices individual words
+    lazily (runtime/dictionary.py slices only keys it hasn't seen)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    if not data:
+        return b"", np.empty(0, dtype=np.int64), np.empty((0, 2), dtype=np.uint32)
     n = len(data)
     max_words = n // 2 + 2
-    words_buf = np.empty(n + 1, dtype=np.uint8)
-    ends = np.empty(max_words, dtype=np.int64)
-    k1 = np.empty(max_words, dtype=np.uint32)
-    k2 = np.empty(max_words, dtype=np.uint32)
+    words_buf, ends, k1, k2 = _buffers(n, max_words)
     count = lib.mr_scan_unique(
         data, n,
         words_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
@@ -93,11 +175,19 @@ def scan_unique(data: bytes) -> tuple[list[bytes], np.ndarray] | None:
         return None
     count = int(count)
     raw = words_buf[: int(ends[count - 1])].tobytes() if count else b""
+    return raw, ends[:count].copy(), np.stack([k1[:count], k2[:count]], axis=1)
+
+
+def scan_unique(data: bytes) -> tuple[list[bytes], np.ndarray] | None:
+    """(unique cleaned words, uint32[n,2] hash pairs) — list form of
+    scan_unique_raw, for callers that want materialized words."""
+    res = scan_unique_raw(data)
+    if res is None:
+        return None
+    raw, ends, keys = res
     words = []
     start = 0
-    for i in range(count):
-        end = int(ends[i])
+    for end in ends.tolist():
         words.append(raw[start:end])
         start = end
-    keys = np.stack([k1[:count], k2[:count]], axis=1)
     return words, keys

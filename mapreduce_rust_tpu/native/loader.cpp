@@ -41,11 +41,17 @@ struct Tables {
 };
 const Tables kTables;
 
+// 16-byte slot, two per cache line: the duplicate test is
+// (k1, k2, len, first-4-bytes) — all inline — so probing a duplicate (the
+// overwhelmingly common case) costs ONE cache miss and never touches the
+// words buffer. Equality short of full bytes is justified by the same
+// birthday bound as the 64-bit key itself (~2^-64; SURVEY.md §7 hard part
+// 3): the words ARE keyed by hash pair throughout the framework, and the
+// Python layer still detects cross-word pair collisions at insert.
 struct Slot {
   uint32_t k1, k2;
-  int64_t off;   // offset into words_out
-  int32_t len;
-  int32_t used;
+  uint32_t prefix;  // first up-to-4 cleaned bytes, zero-padded
+  int32_t len;      // 0 = slot unused
 };
 
 }  // namespace
@@ -73,10 +79,13 @@ int64_t mr_scan_unique(const uint8_t* buf, int64_t len,
   std::vector<Slot> table((size_t)cap);
   std::memset(table.data(), 0, sizeof(Slot) * (size_t)cap);
 
-  std::vector<uint8_t> word;
-  word.reserve(256);
+  // The candidate word is built IN PLACE at words_out+words_len: on insert
+  // it is already where it belongs (no copy); on duplicate the next
+  // candidate simply overwrites it. words_out has capacity >= len, and
+  // committed + candidate bytes can never exceed the input length.
   int64_t n_unique = 0;
   int64_t words_len = 0;
+  int64_t wlen = 0;  // candidate length
 
   auto grow = [&]() {
     int64_t ncap = cap << 1;
@@ -85,63 +94,124 @@ int64_t mr_scan_unique(const uint8_t* buf, int64_t len,
     uint64_t nmask = (uint64_t)ncap - 1;
     for (int64_t j = 0; j < cap; ++j) {
       const Slot& s = table[j];
-      if (!s.used) continue;
+      if (!s.len) continue;
       uint64_t i = (((uint64_t)s.k1 << 32) | s.k2) & nmask;
-      while (ntab[i].used) i = (i + 1) & nmask;
+      while (ntab[i].len) i = (i + 1) & nmask;
       ntab[i] = s;
     }
     table.swap(ntab);
     cap = ncap;
   };
 
+  // Hash lanes accumulate incrementally as word bytes arrive — flush never
+  // re-reads the word (one classify+hash pass over the input total).
+  uint32_t h1 = H1_INIT, h2 = H2_INIT;
+
   auto flush = [&]() -> bool {
-    if (word.empty()) return true;
-    uint32_t h1 = H1_INIT, h2 = H2_INIT;
-    for (uint8_t b : word) {
-      h1 = h1 * H1_MULT + b + 1;
-      h2 = h2 * H2_MULT + b + 1;
+    if (wlen == 0) {
+      h1 = H1_INIT;
+      h2 = H2_INIT;
+      return true;
     }
     if (n_unique * 10 >= cap * 7) grow();  // keep load factor < 0.7
+    const uint8_t* cand = words_out + words_len;
+    uint32_t prefix = 0;
+    std::memcpy(&prefix, cand, (size_t)(wlen < 4 ? wlen : 4));
     uint64_t mask = (uint64_t)cap - 1;
     uint64_t i = (((uint64_t)h1 << 32) | h2) & mask;
     for (;;) {
       Slot& s = table[i];
-      if (!s.used) {
+      if (!s.len) {
         if (n_unique >= max_words) return false;
-        s.used = 1;
         s.k1 = h1;
         s.k2 = h2;
-        s.off = words_len;
-        s.len = (int32_t)word.size();
-        std::memcpy(words_out + words_len, word.data(), word.size());
-        words_len += (int64_t)word.size();
+        s.prefix = prefix;
+        s.len = (int32_t)wlen;
+        words_len += wlen;  // bytes already in place — commit them
         ends_out[n_unique] = words_len;
         k1_out[n_unique] = h1;
         k2_out[n_unique] = h2;
         ++n_unique;
         break;
       }
-      if (s.k1 == h1 && s.k2 == h2 && s.len == (int32_t)word.size() &&
-          std::memcmp(words_out + s.off, word.data(), word.size()) == 0) {
-        break;  // duplicate
+      if (s.k1 == h1 && s.k2 == h2 && s.len == (int32_t)wlen && s.prefix == prefix) {
+        break;  // duplicate — candidate bytes are simply overwritten next
       }
-      i = (i + 1) & mask;  // probe on (true collision or different word)
+      i = (i + 1) & mask;  // probe: different word (or a true pair collision)
     }
-    word.clear();
+    wlen = 0;
+    h1 = H1_INIT;
+    h2 = H2_INIT;
     return true;
   };
 
   for (int64_t p = 0; p < len; ++p) {
     uint8_t c = buf[p];
     uint8_t cls = kTables.cls[c];
-    if (cls == 2) {
+    if (cls == 1) {
+      words_out[words_len + wlen] = c;
+      ++wlen;
+      h1 = h1 * H1_MULT + c + 1;
+      h2 = h2 * H2_MULT + c + 1;
+    } else if (cls == 2) {
       if (!flush()) return -1;
-    } else if (cls == 1) {
-      word.push_back(c);
     }  // cls == 0: punctuation — deleted, does not split the token
   }
   if (!flush()) return -1;
   return n_unique;
+}
+
+// Normalize raw UTF-8 in one pass (the C replacement for
+// core/normalize.normalize_unicode — byte-exact by contract, proven by
+// tests/test_native.py):
+//   - ASCII bytes pass through untouched;
+//   - each non-ASCII codepoint is classified by cpclass[cp] (a table the
+//     Python side builds ONCE from the same `re` \w / isspace rules):
+//     1 = word char (original bytes kept verbatim), 2 = whitespace (one
+//     0x20 per codepoint), 0 = delete;
+//   - malformed sequences decode like Python errors="replace": each bad
+//     byte run becomes U+FFFD, which classifies as delete.
+// Output never exceeds the input length. Returns the normalized length.
+int64_t mr_normalize(const uint8_t* buf, int64_t len,
+                     const uint8_t* cpclass,  // [0x110000]
+                     uint8_t* out) {
+  int64_t o = 0;
+  int64_t p = 0;
+  while (p < len) {
+    uint8_t c = buf[p];
+    if (c < 0x80) {
+      out[o++] = c;
+      ++p;
+      continue;
+    }
+    // Decode one UTF-8 sequence (strict: range checks + continuations).
+    uint32_t cp = 0;
+    int n = 0;
+    if ((c & 0xE0) == 0xC0) { cp = c & 0x1F; n = 1; }
+    else if ((c & 0xF0) == 0xE0) { cp = c & 0x0F; n = 2; }
+    else if ((c & 0xF8) == 0xF0) { cp = c & 0x07; n = 3; }
+    else { ++p; continue; }  // stray continuation/invalid lead → U+FFFD → delete
+    bool ok = (p + n < len);  // truncated sequence at buffer end → invalid
+    for (int j = 1; ok && j <= n; ++j) {
+      if ((buf[p + j] & 0xC0) != 0x80) ok = false;
+      else cp = (cp << 6) | (buf[p + j] & 0x3F);
+    }
+    // Overlong / out-of-range / surrogate → invalid, like Python's strict
+    // decoder: replace (delete) and resync at the next byte.
+    if (!ok || cp > 0x10FFFF || (cp >= 0xD800 && cp <= 0xDFFF) ||
+        (n == 1 && cp < 0x80) || (n == 2 && cp < 0x800) || (n == 3 && cp < 0x10000)) {
+      ++p;  // consume just the lead byte (Python replaces per bad byte)
+      continue;
+    }
+    uint8_t cls = cpclass[cp];
+    if (cls == 1) {
+      for (int j = 0; j <= n; ++j) out[o++] = buf[p + j];
+    } else if (cls == 2) {
+      out[o++] = 0x20;
+    }
+    p += n + 1;
+  }
+  return o;
 }
 
 }  // extern "C"

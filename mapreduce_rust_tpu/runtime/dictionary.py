@@ -57,6 +57,10 @@ class Dictionary:
     def __init__(self) -> None:
         self._word_of: dict[tuple[int, int], bytes] = {}
         self._seen: set[bytes] = set()
+        # (k1<<32)|k2 (always non-negative Python int) → stored word length.
+        # Doubles as the fast-path membership filter AND the cheap collision
+        # probe: a same-pair different-length word is caught without slicing.
+        self._len_of: dict[int, int] = {}
         self.collisions: list[tuple[bytes, bytes]] = []  # (kept, rejected)
 
     def __len__(self) -> int:
@@ -79,12 +83,50 @@ class Dictionary:
                 continue
             seen.add(w)
             key = (k1, k2)
+            self._len_of.setdefault((k1 << 32) | k2, len(w))
             prev = word_of.get(key)
             if prev is None:
                 word_of[key] = w
                 added += 1
             elif prev != w:
                 self.collisions.append((prev, w))
+        return added
+
+    def add_scanned_raw(self, raw: bytes, ends: np.ndarray, keys: np.ndarray) -> int:
+        """Fold a scan_unique_raw result. Keys are filtered against the
+        packed-key table first; word bytes are sliced only for unseen keys,
+        so in steady state (saturated vocabulary) this touches almost
+        nothing. Collision checking on this path: a repeated pair whose
+        word LENGTH differs from the stored word's is sliced and verified
+        (recorded if different); an equal-length different-word pair
+        collision passes undetected — covered by the same ~2^-64 birthday
+        bound as the pair keying itself (SURVEY.md §7 hard part 3)."""
+        packed = (
+            (keys[:, 0].astype(np.uint64) << np.uint64(32)) | keys[:, 1].astype(np.uint64)
+        ).tolist()
+        ends_l = ends.tolist()
+        len_of, word_of, seen = self._len_of, self._word_of, self._seen
+        added = 0
+        prev_end = 0
+        for i, p in enumerate(packed):
+            end = ends_l[i]
+            wlen = end - prev_end
+            stored = len_of.get(p)
+            if stored is None:
+                w = raw[prev_end:end]
+                len_of[p] = wlen
+                seen.add(w)
+                key = (int(keys[i, 0]), int(keys[i, 1]))
+                if key not in word_of:
+                    word_of[key] = w
+                    added += 1
+            elif stored != wlen:
+                w = raw[prev_end:end]
+                prev = word_of.get((int(keys[i, 0]), int(keys[i, 1])))
+                if prev is not None and prev != w and w not in seen:
+                    seen.add(w)
+                    self.collisions.append((prev, w))
+            prev_end = end
         return added
 
     def add_words(self, words: Iterable[bytes]) -> int:
@@ -99,17 +141,22 @@ class Dictionary:
             return 0
         return self._insert_hashed(fresh, hash_words(fresh))
 
+    def add_scanned(self, words: list[bytes], keys: np.ndarray) -> int:
+        """Insert a pre-scanned (words, hash pairs) batch — the driver runs
+        scan_unique on a thread pool (the C pass releases the GIL) and folds
+        results here on one thread; dict state is never touched concurrently."""
+        return self._insert_hashed(words, keys)
+
     def add_text(self, normalized: bytes) -> int:
         """Ingest one normalized chunk. Prefers the one-pass native scanner
         (native/loader.cpp: tokenize+dedupe+hash in C++); falls back to the
         pure-Python three-pass path when the toolchain is unavailable."""
-        from mapreduce_rust_tpu.native.host import scan_unique
+        from mapreduce_rust_tpu.native.host import scan_unique_raw
 
-        res = scan_unique(normalized)
+        res = scan_unique_raw(normalized)
         if res is None:
             return self.add_words(extract_words(normalized))
-        words, keys = res
-        return self._insert_hashed(words, keys)
+        return self.add_scanned_raw(*res)
 
     def items(self) -> Iterator[tuple[tuple[int, int], bytes]]:
         return iter(self._word_of.items())
@@ -121,6 +168,7 @@ class Dictionary:
             if prev is None:
                 self._word_of[key] = w
                 self._seen.add(w)
+                self._len_of.setdefault((key[0] << 32) | key[1], len(w))
             elif prev != w:
                 self.collisions.append((prev, w))
 
@@ -148,6 +196,8 @@ class Dictionary:
                     d.collisions.append((kept, rejected))
                     continue
                 a, b, w = line.rstrip(b"\n").split(b" ", 2)
-                d._word_of[(int(a), int(b))] = w
+                k1, k2 = int(a), int(b)
+                d._word_of[(k1, k2)] = w
                 d._seen.add(w)
+                d._len_of.setdefault((k1 << 32) | k2, len(w))
         return d

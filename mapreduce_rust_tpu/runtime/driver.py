@@ -134,20 +134,123 @@ class JobResult:
     output_files: list[str]
 
 
-def _iter_input_chunks(cfg: Config, inputs: Sequence[str], stats: JobStats,
-                       dictionary: Dictionary, doc_id_offset: int = 0):
-    """Shared ingest: stream chunks, feeding stats + the egress dictionary.
-    doc_id = position in inputs + doc_id_offset (a worker's map task passes
-    its task id so inverted_index doc ids stay global)."""
-    for i, path in enumerate(inputs):
-        stats.bytes_in += os.path.getsize(path)
-        with open(path, "rb") as f:
-            for chunk in chunk_stream(f, doc_id_offset + i, cfg.chunk_bytes):
-                dictionary.add_text(bytes(chunk.data[: chunk.nbytes]))
-                stats.chunks += 1
-                stats.forced_cuts += int(chunk.forced_cut)
-                log.debug("chunk %d doc=%d %dB", stats.chunks, chunk.doc_id, chunk.nbytes)
-                yield chunk
+def _scan_payload(payload: bytes):
+    """Tagged scan result of one chunk — runs on the ingest pool. The
+    native C pass releases the GIL, so scans of consecutive chunks overlap
+    each other, the chunker thread, and device dispatch."""
+    from mapreduce_rust_tpu.native.host import scan_unique_raw
+
+    res = scan_unique_raw(payload)
+    if res is not None:
+        return ("raw", *res)
+    from mapreduce_rust_tpu.core.hashing import hash_words
+    from mapreduce_rust_tpu.runtime.dictionary import extract_words
+
+    seen: set = set()
+    words = [w for w in extract_words(payload) if not (w in seen or seen.add(w))]
+    return ("list", words, hash_words(words))
+
+
+_SENTINEL = object()
+
+
+class _IngestStream:
+    """Shared ingest: a prefetch thread runs read→normalize→chunk ahead of
+    the consumer (bounded queue), and a thread pool runs the dictionary
+    scans; scan results fold into the Dictionary only on the consumer
+    thread. doc_id = position in inputs + doc_id_offset (a worker's map
+    task passes its task id so inverted_index doc ids stay global)."""
+
+    def __init__(self, cfg: Config, inputs: Sequence[str], stats: JobStats,
+                 dictionary: Dictionary, doc_id_offset: int = 0) -> None:
+        import queue
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+
+        self.cfg = cfg
+        self.dictionary = dictionary
+        self.pool = ThreadPoolExecutor(max_workers=max(cfg.ingest_threads, 1))
+        self.scans: collections.deque = collections.deque()
+        self.q: "queue.Queue" = queue.Queue(maxsize=max(cfg.prefetch_chunks, 1))
+        self.err: BaseException | None = None
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._produce, args=(list(inputs), stats, doc_id_offset), daemon=True
+        )
+        self._thread.start()
+
+    def _put(self, item) -> bool:
+        import queue
+
+        while True:
+            try:
+                self.q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                if self._stop:
+                    return False
+
+    def _produce(self, inputs, stats, doc_id_offset) -> None:
+        try:
+            for i, path in enumerate(inputs):
+                stats.bytes_in += os.path.getsize(path)
+                with open(path, "rb") as f:
+                    for chunk in chunk_stream(f, doc_id_offset + i, self.cfg.chunk_bytes):
+                        stats.chunks += 1
+                        stats.forced_cuts += int(chunk.forced_cut)
+                        if not self._put(chunk):
+                            return
+        except BaseException as e:  # re-raised on the consumer thread
+            self.err = e
+        finally:
+            self._put(_SENTINEL)
+
+    def _fold_done(self, block: bool = False) -> None:
+        while self.scans and (block or self.scans[0].done()):
+            kind, *rest = self.scans.popleft().result()
+            if kind == "raw":
+                self.dictionary.add_scanned_raw(*rest)
+            else:
+                self.dictionary.add_scanned(*rest)
+            block = False  # blocking drain pops exactly one
+
+    def __iter__(self):
+        while True:
+            chunk = self.q.get()
+            if chunk is _SENTINEL:
+                if self.err is not None:
+                    raise self.err
+                return
+            self.scans.append(
+                self.pool.submit(_scan_payload, bytes(chunk.data[: chunk.nbytes]))
+            )
+            # Backpressure: each pending future pins a chunk-sized payload;
+            # fold the oldest (blocking) once the backlog exceeds the pool.
+            self._fold_done(block=len(self.scans) > 2 * self.pool._max_workers + 4)
+            yield chunk
+
+    def close(self, abort: bool = False) -> None:
+        """Fold remaining scans and release threads. abort=True (exception
+        path) skips folding and just unblocks + reaps the producer."""
+        self._stop = True
+        if abort:
+            try:
+                while True:
+                    self.q.get_nowait()
+            except Exception:
+                pass
+            for f in self.scans:
+                f.cancel()
+            self.scans.clear()
+        else:
+            while self.scans:
+                kind, *rest = self.scans.popleft().result()
+                if kind == "raw":
+                    self.dictionary.add_scanned_raw(*rest)
+                else:
+                    self.dictionary.add_scanned(*rest)
+        self.pool.shutdown(wait=False)
+        self._thread.join(timeout=5)
 
 
 def _stream_single(cfg: Config, app: App, inputs, stats, acc, dictionary,
@@ -184,19 +287,25 @@ def _stream_single(cfg: Config, app: App, inputs, stats, acc, dictionary,
             stats.spilled_keys += n
             acc.add_batch(evicted)
 
-    for chunk in _iter_input_chunks(cfg, inputs, stats, dictionary, doc_id_offset):
-        chunk_dev = jax.device_put(chunk.data, device)
-        did = jax.device_put(np.int32(chunk.doc_id), device)
-        update, ovf = map_combine(chunk_dev, did)
-        mc_pending.append((update, ovf, chunk_dev, did))
-        if len(mc_pending) > _PIPELINE_DEPTH:
+    ingest = _IngestStream(cfg, inputs, stats, dictionary, doc_id_offset)
+    try:
+        for chunk in ingest:
+            chunk_dev = jax.device_put(chunk.data, device)
+            did = jax.device_put(np.int32(chunk.doc_id), device)
+            update, ovf = map_combine(chunk_dev, did)
+            mc_pending.append((update, ovf, chunk_dev, did))
+            if len(mc_pending) > _PIPELINE_DEPTH:
+                resolve_map_combine()
+            if len(sp_pending) > _PIPELINE_DEPTH:
+                resolve_spill()
+        while mc_pending:
             resolve_map_combine()
-        if len(sp_pending) > _PIPELINE_DEPTH:
+        while sp_pending:
             resolve_spill()
-    while mc_pending:
-        resolve_map_combine()
-    while sp_pending:
-        resolve_spill()
+    except BaseException:
+        ingest.close(abort=True)
+        raise
+    ingest.close()
     acc.add_batch(state)
 
 
@@ -272,17 +381,23 @@ def _stream_mesh(cfg: Config, app: App, inputs, stats, acc, dictionary) -> None:
         if len(sp_pending) > _PIPELINE_DEPTH:
             resolve_spill()
 
-    for chunk in _iter_input_chunks(cfg, inputs, stats, dictionary):
-        group_chunks.append(chunk.data)
-        group_docs.append(chunk.doc_id)
-        if len(group_chunks) == d:
+    ingest = _IngestStream(cfg, inputs, stats, dictionary)
+    try:
+        for chunk in ingest:
+            group_chunks.append(chunk.data)
+            group_docs.append(chunk.doc_id)
+            if len(group_chunks) == d:
+                submit_group()
+        if group_chunks:
             submit_group()
-    if group_chunks:
-        submit_group()
-    while mc_pending:
-        resolve_group()
-    while sp_pending:
-        resolve_spill()
+        while mc_pending:
+            resolve_group()
+        while sp_pending:
+            resolve_spill()
+    except BaseException:
+        ingest.close(abort=True)
+        raise
+    ingest.close()
     acc.add_batch(state)
 
 

@@ -190,9 +190,11 @@ class Worker:
         while True:
             try:
                 tid = await client.call(get)
-            except (ConnectionResetError, BrokenPipeError, OSError):
+            except ConnectionError:
                 # Coordinator exited between our WAIT poll and this call —
                 # the job completed while we slept. A clean end, not a crash.
+                # (ConnectionError only: other OSErrors — fd exhaustion,
+                # network flaps — must surface, not fake success.)
                 log.info("coordinator gone — assuming job complete")
                 return
             if tid == DONE:

@@ -52,6 +52,33 @@ def test_scan_unique_real_corpus():
     assert words == owords and np.array_equal(keys, okeys)
 
 
+@pytest.mark.parametrize("raw", [
+    b"plain ascii only",
+    "don’t — “stop” naïve café".encode(),
+    "tab nbsp emsp splits".encode(),          # unicode whitespace
+    "combin̸ing and \U0001d400math bold".encode(),  # astral word char
+    b"bad \xff\xfe bytes \xe2\x80 truncated",            # invalid UTF-8
+    b"\xed\xa0\x80 surrogate cesu",                      # encoded surrogate
+    "汉字 mixed 日本語 text".encode(),                    # dense non-Latin
+    b"",
+])
+def test_native_normalize_matches_python(raw):
+    from mapreduce_rust_tpu.core.normalize import _normalize_python
+    from mapreduce_rust_tpu.native.host import normalize_native
+
+    assert normalize_native(raw) == _normalize_python(raw)
+
+
+def test_native_normalize_real_corpus():
+    from mapreduce_rust_tpu.core.normalize import _normalize_python
+    from mapreduce_rust_tpu.native.host import normalize_native
+
+    raw = (CORPUS / "gut-4.txt").read_bytes() if CORPUS.exists() else (
+        "mixed — “text” naïve ".encode() * 5000
+    )
+    assert normalize_native(raw) == _normalize_python(raw)
+
+
 def test_dense_vocabulary_no_hang():
     # 4097+ distinct 2-byte words once filled the fixed-size table and made
     # the probe loop spin forever (review r2); growth must handle it.
