@@ -39,6 +39,8 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--port", type=int, default=1040)
     p.add_argument("--chunk-mb", type=float, default=4.0)
     p.add_argument("--device", default="auto", choices=["auto", "tpu", "cpu"])
+    p.add_argument("--profile-dir", default=None,
+                   help="write a jax.profiler trace of the stream phase")
     p.add_argument("-v", "--verbose", action="store_true")
 
 
@@ -50,6 +52,7 @@ def _cfg(args, map_n: int = 1, worker_n: int = 1) -> Config:
         chunk_bytes=int(args.chunk_mb * (1 << 20)),
         device=args.device,
         mesh_shape=getattr(args, "mesh", None),
+        profile_dir=args.profile_dir,
         host=args.host,
         port=args.port,
         input_dir=args.input,
@@ -117,6 +120,10 @@ def cmd_merge(args) -> int:
 def cmd_clean(args) -> int:
     """Reference src/clean.sh:7-12: remove intermediates + outputs."""
     removed = 0
+    journal = os.path.join(args.work, "coordinator.journal")
+    if os.path.exists(journal):
+        os.remove(journal)
+        removed += 1
     for pattern in ("mr-*.npz", "dict-*.txt"):
         for p in glob.glob(os.path.join(args.work, pattern)):
             os.remove(p)

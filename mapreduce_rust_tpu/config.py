@@ -35,6 +35,9 @@ class Config:
     mesh_shape: Optional[int] = None  # devices in the 1-D mesh (None = all)
     ingest_threads: int = 4         # host threads for dictionary scans
     prefetch_chunks: int = 8        # chunker read-ahead depth (host queue)
+    profile_dir: Optional[str] = None  # write a jax.profiler trace of the
+                                    # stream phase here (view with
+                                    # tensorboard / xprof)
 
     # ---- Control plane (reference timings preserved) ----
     host: str = "127.0.0.1"

@@ -36,6 +36,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
 import time
 
 from mapreduce_rust_tpu.config import Config
@@ -105,13 +106,97 @@ class _Phase:
 
 
 class Coordinator:
-    """In-process scheduler state; serve() exposes it over TCP."""
+    """In-process scheduler state; serve() exposes it over TCP.
 
-    def __init__(self, cfg: Config) -> None:
+    Checkpoint/resume (SURVEY.md §5): the reference has no coordinator
+    persistence — coordinator death loses the run even though the
+    materialized mr-{m}-{r}.txt files could seed a restart. Here completed
+    task ids are journaled to ``{work_dir}/coordinator.journal`` (one line
+    per completion, fsync-free append — the spill files themselves are the
+    ground truth and are written atomically); a restarted coordinator
+    pre-marks journaled tasks done, so the job resumes from the last
+    completed task instead of from scratch.
+    """
+
+    def __init__(self, cfg: Config, resume: bool = True) -> None:
         self.cfg = cfg
         self.map = _Phase(cfg.map_n, cfg.lease_timeout_s)
         self.reduce = _Phase(cfg.reduce_n, cfg.lease_timeout_s)
         self.worker_count = 0
+        self._journal_path = os.path.join(cfg.work_dir, "coordinator.journal")
+        if resume:
+            self._replay_journal()
+
+    # ---- journal (checkpoint/resume) ----
+
+    def _header(self) -> str:
+        """Job identity line: shape + a fingerprint of the input listing
+        (name, size, mtime per file) — a rerun over different inputs in the
+        same work_dir must start fresh, not resume the stale journal."""
+        import glob
+        import hashlib
+
+        sig = hashlib.sha256()
+        paths = sorted(glob.glob(os.path.join(self.cfg.input_dir, self.cfg.input_pattern)))
+        for p in paths:
+            try:
+                st = os.stat(p)
+                sig.update(f"{os.path.basename(p)}:{st.st_size}:{st.st_mtime_ns};".encode())
+            except OSError:
+                sig.update(f"{os.path.basename(p)}:gone;".encode())
+        return f"job {self.cfg.map_n} {self.cfg.reduce_n} {sig.hexdigest()[:16]}"
+
+    def _replay_journal(self) -> None:
+        try:
+            with open(self._journal_path, "r") as f:
+                data = f.read()
+        except OSError:
+            return
+        lines = data.splitlines()
+        if lines and not data.endswith("\n"):
+            lines.pop()  # torn tail from a crashed append — never trust it
+        # A journal from a different job must not seed this one.
+        if not lines or lines[0] != self._header():
+            if lines:
+                log.warning("journal is for a different job (%r) — ignoring", lines[0])
+                try:
+                    os.remove(self._journal_path)
+                except OSError:
+                    pass
+            return
+        for line in lines[1:]:
+            try:
+                phase_name, tid_s = line.split()
+                tid = int(tid_s)
+            except ValueError:
+                continue
+            if phase_name not in ("map", "reduce"):
+                continue  # corrupt record — never guess a phase
+            phase = self.map if phase_name == "map" else self.reduce
+            if 0 <= tid < phase.n:
+                phase.assigned[tid] = True
+                phase.next_id = max(phase.next_id, tid + 1)
+        # Recompute finish flags; grant() then serves only the gaps.
+        for phase in (self.map, self.reduce):
+            if phase.next_id >= phase.n and all(phase.assigned.values()):
+                phase.finished = True
+        if self.map.finished or any(self.map.assigned.values()):
+            log.info(
+                "journal: resumed %d/%d map, %d/%d reduce completions",
+                sum(self.map.assigned.values()), self.map.n,
+                sum(self.reduce.assigned.values()), self.reduce.n,
+            )
+
+    def _journal(self, phase_name: str, tid: int) -> None:
+        try:
+            os.makedirs(self.cfg.work_dir, exist_ok=True)
+            fresh = not os.path.exists(self._journal_path)
+            with open(self._journal_path, "a") as f:
+                if fresh:
+                    f.write(self._header() + "\n")
+                f.write(f"{phase_name} {tid}\n")
+        except OSError as e:
+            log.warning("journal write failed: %s", e)
 
     # ---- the 7 RPCs (coordinator.rs:102-111) ----
 
@@ -143,11 +228,13 @@ class Coordinator:
 
     def report_map_task_finish(self, tid: int) -> bool:
         done = self.map.report_finish(tid)
+        self._journal("map", tid)
         log.info("map %d finished (phase done=%s)", tid, done)
         return done
 
     def report_reduce_task_finish(self, tid: int) -> bool:
         done = self.reduce.report_finish(tid)
+        self._journal("reduce", tid)
         log.info("reduce %d finished (job done=%s)", tid, done)
         return done
 

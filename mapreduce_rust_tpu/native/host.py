@@ -108,7 +108,8 @@ def _cpclass() -> np.ndarray:
             cls[i + 0x80] = 2
     _cpclass_arr = cls
     try:
-        tmp = _CPCLASS_CACHE.with_name(f".cpclass.{os.getpid()}.tmp")
+        # np.savez appends '.npz' unless the name already ends with it.
+        tmp = _CPCLASS_CACHE.with_name(f".cpclass.{os.getpid()}.tmp.npz")
         np.savez_compressed(tmp, cls=cls, unidata=fingerprint)
         os.replace(tmp, _CPCLASS_CACHE)
     except OSError:

@@ -169,7 +169,8 @@ class _IngestStream:
 
         self.cfg = cfg
         self.dictionary = dictionary
-        self.pool = ThreadPoolExecutor(max_workers=max(cfg.ingest_threads, 1))
+        self.workers = max(cfg.ingest_threads, 1)
+        self.pool = ThreadPoolExecutor(max_workers=self.workers)
         self.scans: collections.deque = collections.deque()
         self.q: "queue.Queue" = queue.Queue(maxsize=max(cfg.prefetch_chunks, 1))
         self.err: BaseException | None = None
@@ -226,7 +227,7 @@ class _IngestStream:
             )
             # Backpressure: each pending future pins a chunk-sized payload;
             # fold the oldest (blocking) once the backlog exceeds the pool.
-            self._fold_done(block=len(self.scans) > 2 * self.pool._max_workers + 4)
+            self._fold_done(block=len(self.scans) > 2 * self.workers + 4)
             yield chunk
 
     def close(self, abort: bool = False) -> None:
@@ -244,11 +245,7 @@ class _IngestStream:
             self.scans.clear()
         else:
             while self.scans:
-                kind, *rest = self.scans.popleft().result()
-                if kind == "raw":
-                    self.dictionary.add_scanned_raw(*rest)
-                else:
-                    self.dictionary.add_scanned(*rest)
+                self._fold_done(block=True)
         self.pool.shutdown(wait=False)
         self._thread.join(timeout=5)
 
@@ -418,7 +415,14 @@ def run_job(
     acc = HostAccumulator(app.combine_op)
     dictionary = Dictionary()
 
-    with stats.phase("stream"):
+    import contextlib
+
+    prof = (
+        jax.profiler.trace(cfg.profile_dir)
+        if cfg.profile_dir
+        else contextlib.nullcontext()
+    )
+    with stats.phase("stream"), prof:
         if cfg.mesh_shape and cfg.mesh_shape > 1:
             _stream_mesh(cfg, app, inputs, stats, acc, dictionary)
         else:

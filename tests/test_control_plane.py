@@ -216,6 +216,68 @@ def test_cluster_device_engine_inverted_index(tmp_path):
     assert got == want
 
 
+def test_journal_resume_skips_completed_maps(tmp_path):
+    # Run a full job, wipe ONLY the reduce outputs + reduce journal lines,
+    # restart the cluster: maps must not re-run (spill mtimes unchanged),
+    # reduce regenerates identical output from the materialized spills —
+    # the phase-checkpoint story (SURVEY.md §5 checkpoint row).
+    write_corpus(tmp_path)
+    cfg = make_cfg(tmp_path, len(TEXTS), worker_n=1)
+    asyncio.run(_run_cluster(cfg, 1))
+    want = read_outputs(cfg)
+
+    journal = pathlib.Path(cfg.work_dir) / "coordinator.journal"
+    lines = [
+        ln for ln in journal.read_text().splitlines()
+        if ln.startswith(("job ", "map "))
+    ]
+    journal.write_text("\n".join(lines) + "\n")
+    for p in pathlib.Path(cfg.output_dir).glob("mr-*.txt"):
+        p.unlink()
+    spill_mtimes = {
+        p.name: p.stat().st_mtime_ns
+        for p in pathlib.Path(cfg.work_dir).glob("mr-*.npz")
+    }
+
+    cfg2 = make_cfg(tmp_path, len(TEXTS), worker_n=1, port=free_port())
+    asyncio.run(_run_cluster(cfg2, 1))
+    assert read_outputs(cfg2) == want == oracle()
+    after = {
+        p.name: p.stat().st_mtime_ns
+        for p in pathlib.Path(cfg.work_dir).glob("mr-*.npz")
+    }
+    assert after == spill_mtimes  # maps were not re-executed
+
+
+def test_journal_replay_unit(tmp_path):
+    cfg = make_cfg(tmp_path, 3, worker_n=1)
+    c = Coordinator(cfg)
+    c.get_worker_id()
+    assert c.get_map_task() == 0
+    c.report_map_task_finish(0)
+    assert c.get_map_task() == 1
+    c.report_map_task_finish(1)
+    # restart: tasks 0,1 journaled; only task 2 should be granted
+    c2 = Coordinator(cfg)
+    c2.get_worker_id()
+    assert c2.get_map_task() == 2
+    assert c2.get_map_task() == WAIT
+    assert c2.report_map_task_finish(2)
+    assert c2.map.finished
+
+
+def test_journal_shape_mismatch_ignored(tmp_path):
+    cfg = make_cfg(tmp_path, 3, worker_n=1)
+    c = Coordinator(cfg)
+    c.get_worker_id()
+    c.report_map_task_finish(c.get_map_task())
+    # Different job shape in the same work_dir: journal must be ignored.
+    cfg2 = make_cfg(tmp_path, 2, worker_n=1, reduce_n=2, port=free_port())
+    c2 = Coordinator(cfg2)
+    c2.get_worker_id()
+    assert c2.get_map_task() == 0  # starts from scratch
+
+
 def test_cli_run_single_process(tmp_path, capsys):
     write_corpus(tmp_path)
     from mapreduce_rust_tpu.__main__ import main
