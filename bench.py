@@ -2,18 +2,27 @@
 """End-to-end benchmark: word-count GB/s on TPU vs the CPU multi-process
 baseline (BASELINE.md configs 1-3).
 
-Prints ONE JSON line:
+Prints ONE JSON line on stdout, ALWAYS (an "error" field appears on partial
+failure):
     {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N}
 
-- Corpus: the 4.11 MB reference corpus (/root/reference/src/data/gut-*.txt)
-  replicated to ~128 MB (cached in .bench/, gitignored).
-- Baseline: a faithful CPU multi-process word count — the reference's exact
-  per-task work (regex strip + split + Counter; src/app/wc.rs:6-17) over
-  whitespace-aligned byte slices on a worker pool, like its map_n×worker_n
-  process model (src/bin/mrworker.rs:43-151). Measured on a 32 MB slice.
-- TPU run: the full framework path (normalize → chunk → device tokenize/
-  hash/sort/segment-reduce → merge → dictionary egress), compile excluded
-  via a warmup pass over a small prefix (jit caches are in-process).
+Structure (round-3 verdict: the old layout ran the fragile TPU leg first,
+unguarded, and lost the number three rounds running):
+  1. corpus build (cheap, deterministic, cached in .bench/);
+  2. CPU multi-process baseline FIRST — needs no JAX, cannot hang on a
+     wedged TPU plugin. Faithful reference-semantics per-task work (regex
+     strip + split + count; src/app/wc.rs:6-17) over whitespace-aligned
+     slices on a process pool like its map_n×worker_n model
+     (src/bin/mrworker.rs:43-151);
+  3. device leg in a SUBPROCESS with a hard timeout — a crashed / wedged /
+     version-skewed TPU runtime costs us the leg, not the JSON line;
+  4. on device-leg failure, a bounded CPU-XLA fallback subprocess (smaller
+     corpus) so "value" is still a measured number of the same pipeline.
+
+The device leg itself relies on two caches so warm != cold is real:
+module-level step-fn caches (runtime/driver.py make_step_fns) and the
+persistent XLA compilation cache (<repo>/.jax_cache), which survives across
+processes — the warmup pass compiles at most once per machine image.
 """
 
 from __future__ import annotations
@@ -23,6 +32,7 @@ import json
 import multiprocessing
 import os
 import pathlib
+import subprocess
 import sys
 import time
 
@@ -31,6 +41,9 @@ REF_DATA = pathlib.Path("/root/reference/src/data")
 BENCH_DIR = REPO / ".bench"
 TARGET_MB = int(os.environ.get("BENCH_TARGET_MB", "128"))
 BASELINE_MB = int(os.environ.get("BENCH_BASELINE_MB", "32"))
+FALLBACK_MB = int(os.environ.get("BENCH_FALLBACK_MB", "16"))
+DEVICE_TIMEOUT_S = int(os.environ.get("BENCH_DEVICE_TIMEOUT_S", "300"))
+FALLBACK_TIMEOUT_S = int(os.environ.get("BENCH_FALLBACK_TIMEOUT_S", "150"))
 
 _WS = b" \t\n\r\x0b\x0c"
 
@@ -94,54 +107,150 @@ def cpu_baseline_gbs(path: pathlib.Path, limit_bytes: int, workers: int = 8) -> 
     return limit_bytes / dt / 1e9
 
 
-def tpu_run_gbs(path: pathlib.Path) -> tuple[float, dict]:
+def device_leg(path: str) -> None:
+    """Runs INSIDE the bench subprocess: full framework path, prints one
+    JSON line {gbs, info} on stdout."""
     from mapreduce_rust_tpu.config import Config
-    from mapreduce_rust_tpu.runtime.driver import run_job
+    from mapreduce_rust_tpu.runtime.driver import enable_compilation_cache, run_job
 
+    enable_compilation_cache("auto")
     cfg = Config(
-        chunk_bytes=1 << 22,
-        merge_capacity=1 << 21,
+        map_engine=os.environ.get("BENCH_MAP_ENGINE", "host"),
+        host_window_bytes=16 << 20,
+        chunk_bytes=1 << 20,
+        merge_capacity=1 << 18,
         reduce_n=4,
         output_dir=str(BENCH_DIR / "out"),
         device="auto",
     )
-    # Warmup: compile every jitted step on a small prefix with identical
-    # static shapes (first TPU compile is ~20-40 s and must not be timed).
+    # Warmup: compile every jitted step on a one-window prefix with the
+    # same static shapes as the main run. The step-fn cache makes the main
+    # run reuse these compiled closures; the persistent cache makes even
+    # this pass cheap after the first run on a machine image.
     warm = BENCH_DIR / "warmup.txt"
     with open(path, "rb") as f:
-        warm.write_bytes(f.read(cfg.chunk_bytes + 1024))
+        warm.write_bytes(f.read(cfg.host_window_bytes + 4096))
     run_job(cfg, [str(warm)], write_outputs=False)
 
     res = run_job(cfg, [str(path)])
+    s = res.stats
     info = {
-        "bytes": res.stats.bytes_in,
-        "wall_s": round(res.stats.wall_seconds, 3),
-        "distinct": res.stats.distinct_keys,
-        "chunks": res.stats.chunks,
-        "spills": res.stats.spill_events,
-        "collisions": res.stats.hash_collisions,
-        "phases": {k: round(v, 3) for k, v in res.stats.phase_seconds.items()},
+        "bytes": s.bytes_in,
+        "wall_s": round(s.wall_seconds, 3),
+        "distinct": s.distinct_keys,
+        "chunks": s.chunks,
+        "spills": s.spill_events,
+        "collisions": s.hash_collisions,
+        "ingest_wait_s": round(s.ingest_wait_s, 3),
+        "device_wait_s": round(s.device_wait_s, 3),
+        "bottleneck": s.bottleneck,
+        "map_engine": cfg.map_engine,
+        "phases": {k: round(v, 3) for k, v in s.phase_seconds.items()},
+        "platform": _platform_name(),
     }
-    return res.stats.gb_per_s, info
+    print(json.dumps({"gbs": s.gb_per_s, "info": info}))
+
+
+def _platform_name() -> str:
+    try:
+        import jax
+
+        return jax.devices()[0].platform
+    except Exception:
+        return "unknown"
+
+
+def _run_device_subprocess(corpus: pathlib.Path, timeout_s: int, env_extra: dict):
+    """Launch the device leg; return (parsed dict | None, error string | None)."""
+    env = dict(os.environ, **env_extra)
+    try:
+        r = subprocess.run(
+            [sys.executable, str(REPO / "bench.py"), "--device-leg", str(corpus)],
+            capture_output=True, text=True, timeout=timeout_s, env=env, cwd=str(REPO),
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"device leg timed out after {timeout_s}s"
+    sys.stderr.write(r.stderr[-4000:])
+    for line in reversed(r.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), None
+            except json.JSONDecodeError:
+                break
+    tail = (r.stderr or r.stdout or "").strip().splitlines()
+    return None, f"device leg rc={r.returncode}: {tail[-1] if tail else 'no output'}"
 
 
 def main() -> None:
-    corpus = build_corpus(TARGET_MB)
-    gbs, info = tpu_run_gbs(corpus)
-    base_gbs = cpu_baseline_gbs(corpus, min(BASELINE_MB << 20, corpus.stat().st_size))
+    errors: list[str] = []
+    base_gbs = None
+    dev = None
+    fallback = False
+
+    try:
+        corpus = build_corpus(TARGET_MB)
+    except Exception as e:  # disk pressure etc. — shrink, never die
+        errors.append(f"corpus: {e!r}")
+        corpus = build_corpus(8)
+
+    try:
+        base_gbs = cpu_baseline_gbs(corpus, min(BASELINE_MB << 20, corpus.stat().st_size))
+        print(f"cpu baseline: {base_gbs:.4f} GB/s", file=sys.stderr)
+    except Exception as e:
+        errors.append(f"cpu_baseline: {e!r}")
+
+    dev, err = _run_device_subprocess(corpus, DEVICE_TIMEOUT_S, {})
+    if dev is None:
+        errors.append(err)
+        fallback = True
+        small = build_corpus(FALLBACK_MB)
+        dev, err = _run_device_subprocess(
+            small, FALLBACK_TIMEOUT_S, {"JAX_PLATFORMS": "cpu"}
+        )
+        if dev is None:
+            errors.append(f"fallback: {err}")
+
+    value = round(dev["gbs"], 4) if dev else None
+    platform = dev["info"].get("platform", "unknown") if dev else "none"
+    # The corpus label comes from the bytes the measured leg actually
+    # processed — never from what was merely intended.
+    measured_mb = round(dev["info"]["bytes"] / (1 << 20)) if dev else 0
     result = {
-        "metric": f"word_count GB/s end-to-end ({TARGET_MB}MB corpus, single TPU chip "
-        f"vs {BASELINE_MB}MB 8-proc CPU baseline)",
-        "value": round(gbs, 4),
+        "metric": (
+            f"word_count GB/s end-to-end ({measured_mb}MB corpus, single {platform} chip"
+            f"{' [cpu-xla fallback]' if fallback else ''} "
+            f"vs {BASELINE_MB}MB 8-proc CPU baseline)"
+            if dev
+            else "word_count GB/s end-to-end (no device measurement)"
+        ),
+        "value": value,
         "unit": "GB/s",
-        "vs_baseline": round(gbs / base_gbs, 2) if base_gbs else None,
+        "vs_baseline": (
+            round(value / base_gbs, 2) if value is not None and base_gbs else None
+        ),
     }
+    if errors:
+        result["error"] = "; ".join(errors)
     print(json.dumps(result))
-    print(
-        json.dumps({"detail": info, "cpu_baseline_gbs": round(base_gbs, 4)}),
-        file=sys.stderr,
-    )
+    if dev:
+        print(
+            json.dumps({"detail": dev["info"],
+                        "cpu_baseline_gbs": round(base_gbs, 4) if base_gbs else None}),
+            file=sys.stderr,
+        )
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "--device-leg":
+        device_leg(sys.argv[2])
+    else:
+        try:
+            main()
+        except BaseException as e:  # the JSON line survives ANY failure
+            print(json.dumps({
+                "metric": "word_count GB/s end-to-end",
+                "value": None, "unit": "GB/s", "vs_baseline": None,
+                "error": f"bench harness: {e!r}",
+            }))
+            raise SystemExit(1)

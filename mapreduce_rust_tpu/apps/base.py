@@ -48,6 +48,21 @@ class App:
         """On-device per-chunk transform; doc_id is a traced int32 scalar."""
         return kv
 
+    def host_values(self, counts, doc_id: int):
+        """Host-map-engine counterpart of device_map: values for one
+        window's unique keys, given their occurrence counts (uint32[n]).
+        Must agree with device_map ∘ combine_op — the two engines are
+        interchangeable and tested equal (tests/test_driver.py). The
+        default is only correct for sum apps (occurrence counts); any
+        other combine_op must override rather than inherit a silently
+        wrong value stream."""
+        if self.combine_op != "sum":
+            raise NotImplementedError(
+                f"app {self.name!r} (combine_op={self.combine_op!r}) must "
+                "override host_values to run under map_engine='host'"
+            )
+        return counts
+
     def finalize(
         self, items: Iterable[tuple[bytes, "FinalValue", tuple[int, int]]], reduce_n: int
     ) -> dict[int, list[bytes]]:

@@ -36,5 +36,12 @@ class InvertedIndex(App):
             valid=kv.valid,
         )
 
+    def host_values(self, counts, doc_id: int):
+        """Every unique term of the window posts this window's doc_id —
+        the host-engine mirror of device_map's doc_id stamp."""
+        import numpy as np
+
+        return np.full(len(counts), doc_id, dtype=np.uint32)
+
     def format_line(self, word: bytes, value) -> bytes:
         return b"%s %s" % (word, ",".join(str(d) for d in value).encode())

@@ -32,12 +32,48 @@ class Config:
                                     # exact — see effective_partial_capacity)
     bucket_capacity_factor: float = 2.0  # all_to_all per-bucket slack
     device: str = "auto"            # "auto" | "tpu" | "cpu"
+    map_engine: str = "device"      # "device": tokenize/hash/combine fully
+                                    # on-chip (the TPU-native kernels;
+                                    # best when the chip link is wide).
+                                    # "host": the fused native C scan maps
+                                    # each window on the host — the same
+                                    # pass that builds the egress dictionary
+                                    # — and ships compacted (key, value)
+                                    # updates; the device runs merge/
+                                    # shuffle/reduce. Mirrors the reference
+                                    # split (map UDF on the worker CPU,
+                                    # src/app/wc.rs:6-13; the framework owns
+                                    # the shuffle) and wins end-to-end when
+                                    # host→device bandwidth is the
+                                    # bottleneck (e.g. a tunneled chip).
+    host_window_bytes: int = 16 << 20  # map window for the host engine
+    host_update_cap: int = 1 << 16  # fixed per-merge update capacity of the
+                                    # host engine; windows with more uniques
+                                    # are split across several merges. Fixed
+                                    # so the engine compiles EXACTLY ONE
+                                    # merge shape — variable caps meant a
+                                    # tail window could trigger a fresh ~40 s
+                                    # XLA compile mid-run.
     mesh_shape: Optional[int] = None  # devices in the 1-D mesh (None = all)
     ingest_threads: int = 4         # host threads for dictionary scans
     prefetch_chunks: int = 8        # chunker read-ahead depth (host queue)
+    pipeline_depth: int = 64        # in-flight device steps before the host
+                                    # reads back their (async-copied) counters.
+                                    # Sized to hide the device→host round trip
+                                    # (~80 ms through a tunneled TPU) behind
+                                    # ~sub-ms dispatches; costs O(depth) chunk
+                                    # buffers of host RAM + update-sized device
+                                    # buffers.
     profile_dir: Optional[str] = None  # write a jax.profiler trace of the
                                     # stream phase here (view with
                                     # tensorboard / xprof)
+    compilation_cache_dir: Optional[str] = "auto"  # persistent XLA compile
+                                    # cache shared across processes ("auto"
+                                    # → <repo>/.jax_cache; None/"" disables).
+                                    # XLA compiles of the step fns are tens
+                                    # of seconds; without this every process
+                                    # (bench, each worker, the dryrun) pays
+                                    # them again.
 
     # ---- Control plane (reference timings preserved) ----
     host: str = "127.0.0.1"
@@ -58,6 +94,8 @@ class Config:
             raise ValueError("map_n, reduce_n, worker_n must be positive")
         if self.chunk_bytes <= 2 * self.max_word_len:
             raise ValueError("chunk_bytes too small for max_word_len halo")
+        if self.map_engine not in ("device", "host"):
+            raise ValueError(f"unknown map_engine {self.map_engine!r}")
 
     def effective_partial_capacity(self) -> int:
         """The per-chunk distinct-key capacity both stream paths must share

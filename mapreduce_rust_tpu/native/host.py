@@ -67,7 +67,18 @@ def get_lib() -> ctypes.CDLL | None:
                 ctypes.c_char_p, ctypes.c_int64,
                 ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint8),
             ]
-        except OSError as e:
+            lib.mr_scan_count.restype = ctypes.c_int64
+            lib.mr_scan_count.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint32),
+                ctypes.POINTER(ctypes.c_uint32),
+                ctypes.c_int64,
+            ]
+        except (OSError, AttributeError) as e:
+            # AttributeError: a stale .so (fresh mtime, old ABI) missing a
+            # newer symbol must engage the Python fallback, not crash.
             log.warning("native load failed (%s) — using Python fallback", e)
             return None
         _lib = lib
@@ -146,9 +157,57 @@ def _buffers(n: int, max_words: int):
             np.empty(max(max_words, 1 << 18), dtype=np.int64),
             np.empty(max(max_words, 1 << 18), dtype=np.uint32),
             np.empty(max(max_words, 1 << 18), dtype=np.uint32),
+            np.empty(max(max_words, 1 << 18), dtype=np.uint32),
         )
         _scratch.bufs = bufs
     return bufs
+
+
+def scan_count_raw(
+    data: bytes,
+) -> tuple[bytes, np.ndarray, np.ndarray, np.ndarray] | None:
+    """(concatenated unique words, int64[n] end offsets, uint32[n,2] hash
+    pairs, uint32[n] occurrence counts) over RAW un-normalized UTF-8 — the
+    fused one-pass map kernel of the host-map engine, or None when the
+    native lib is unavailable. Byte-exact equivalent of
+    normalize_unicode → scan_unique_raw plus per-word counting
+    (tests/test_native.py proves the equivalence)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    empty = (
+        b"",
+        np.empty(0, dtype=np.int64),
+        np.empty((0, 2), dtype=np.uint32),
+        np.empty(0, dtype=np.uint32),
+    )
+    if not data:
+        return empty
+    n = len(data)
+    max_words = n // 2 + 2
+    words_buf, ends, k1, k2, counts = _buffers(n, max_words)
+    count = lib.mr_scan_count(
+        data, n,
+        _cpclass().ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        words_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        ends.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        k1.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        k2.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        counts.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        max_words,
+    )
+    if count < 0:  # cannot happen with max_words = n//2+2; belt and braces
+        return None
+    count = int(count)
+    if not count:
+        return empty
+    raw = words_buf[: int(ends[count - 1])].tobytes()
+    return (
+        raw,
+        ends[:count].copy(),
+        np.stack([k1[:count], k2[:count]], axis=1),
+        counts[:count].copy(),
+    )
 
 
 def scan_unique_raw(data: bytes) -> tuple[bytes, np.ndarray, np.ndarray] | None:
@@ -163,7 +222,7 @@ def scan_unique_raw(data: bytes) -> tuple[bytes, np.ndarray, np.ndarray] | None:
         return b"", np.empty(0, dtype=np.int64), np.empty((0, 2), dtype=np.uint32)
     n = len(data)
     max_words = n // 2 + 2
-    words_buf, ends, k1, k2 = _buffers(n, max_words)
+    words_buf, ends, k1, k2, _counts = _buffers(n, max_words)
     count = lib.mr_scan_unique(
         data, n,
         words_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),

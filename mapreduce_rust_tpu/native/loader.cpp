@@ -161,6 +161,151 @@ int64_t mr_scan_unique(const uint8_t* buf, int64_t len,
   return n_unique;
 }
 
+// Fused normalize + tokenize + dedupe + count over RAW UTF-8, one pass.
+// Equivalent by construction to mr_normalize followed by mr_scan_unique —
+// word-class bytes are hashed/appended verbatim, whitespace-class
+// codepoints flush the token, delete-class codepoints vanish without
+// splitting it — but touches every byte once instead of three times
+// (normalize write + normalized read + scan). This is the map side of the
+// host-map engine (runtime/driver.py _stream_host_map): the same token
+// stream feeds the egress dictionary AND, with counts_out, the data plane
+// update the TPU merges. The reference does its map exactly here too — on
+// the worker CPU (src/app/wc.rs:6-13) — the framework's job being the
+// shuffle/reduce behind it.
+//   counts_out[i] = occurrences of unique word i in this buffer.
+// Returns unique-word count, or -1 if max_words was too small.
+int64_t mr_scan_count(const uint8_t* buf, int64_t len,
+                      const uint8_t* cpclass,  // [0x110000]
+                      uint8_t* words_out, int64_t* ends_out,
+                      uint32_t* k1_out, uint32_t* k2_out, uint32_t* counts_out,
+                      int64_t max_words) {
+  // Start cache-sized and grow at 70% load: sizing by len/16 would build a
+  // table proportional to the WINDOW (20 MB for a 16 MB window) and turn
+  // every probe into a DRAM miss; typical windows have far fewer uniques
+  // than bytes/16, and growth amortizes for the ones that don't.
+  int64_t cap = 1 << 15;
+  struct CSlot {
+    uint32_t k1, k2;
+    uint32_t prefix;
+    int32_t len;   // 0 = unused
+    uint32_t idx;  // output index (counts_out[idx] is this word's count)
+  };
+  std::vector<CSlot> table((size_t)cap);
+  std::memset(table.data(), 0, sizeof(CSlot) * (size_t)cap);
+
+  int64_t n_unique = 0;
+  int64_t words_len = 0;
+  int64_t wlen = 0;
+  uint32_t h1 = H1_INIT, h2 = H2_INIT;
+
+  auto grow = [&]() {
+    int64_t ncap = cap << 1;
+    std::vector<CSlot> ntab((size_t)ncap);
+    std::memset(ntab.data(), 0, sizeof(CSlot) * (size_t)ncap);
+    uint64_t nmask = (uint64_t)ncap - 1;
+    for (int64_t j = 0; j < cap; ++j) {
+      const CSlot& s = table[j];
+      if (!s.len) continue;
+      uint64_t i = (((uint64_t)s.k1 << 32) | s.k2) & nmask;
+      while (ntab[i].len) i = (i + 1) & nmask;
+      ntab[i] = s;
+    }
+    table.swap(ntab);
+    cap = ncap;
+  };
+
+  auto flush = [&]() -> bool {
+    if (wlen == 0) {
+      h1 = H1_INIT;
+      h2 = H2_INIT;
+      return true;
+    }
+    if (n_unique * 10 >= cap * 7) grow();
+    const uint8_t* cand = words_out + words_len;
+    uint32_t prefix = 0;
+    std::memcpy(&prefix, cand, (size_t)(wlen < 4 ? wlen : 4));
+    uint64_t mask = (uint64_t)cap - 1;
+    uint64_t i = (((uint64_t)h1 << 32) | h2) & mask;
+    for (;;) {
+      CSlot& s = table[i];
+      if (!s.len) {
+        if (n_unique >= max_words) return false;
+        s.k1 = h1;
+        s.k2 = h2;
+        s.prefix = prefix;
+        s.len = (int32_t)wlen;
+        s.idx = (uint32_t)n_unique;
+        words_len += wlen;
+        ends_out[n_unique] = words_len;
+        k1_out[n_unique] = h1;
+        k2_out[n_unique] = h2;
+        counts_out[n_unique] = 1;
+        ++n_unique;
+        break;
+      }
+      if (s.k1 == h1 && s.k2 == h2 && s.len == (int32_t)wlen && s.prefix == prefix) {
+        ++counts_out[s.idx];
+        break;
+      }
+      i = (i + 1) & mask;
+    }
+    wlen = 0;
+    h1 = H1_INIT;
+    h2 = H2_INIT;
+    return true;
+  };
+
+  int64_t p = 0;
+  while (p < len) {
+    uint8_t c = buf[p];
+    if (c < 0x80) {  // ASCII fast path — the kTables classes
+      uint8_t cls = kTables.cls[c];
+      if (cls == 1) {
+        words_out[words_len + wlen] = c;
+        ++wlen;
+        h1 = h1 * H1_MULT + c + 1;
+        h2 = h2 * H2_MULT + c + 1;
+      } else if (cls == 2) {
+        if (!flush()) return -1;
+      }
+      ++p;
+      continue;
+    }
+    // Non-ASCII: decode exactly like mr_normalize, classify via cpclass.
+    uint32_t cp = 0;
+    int n = 0;
+    if ((c & 0xE0) == 0xC0) { cp = c & 0x1F; n = 1; }
+    else if ((c & 0xF0) == 0xE0) { cp = c & 0x0F; n = 2; }
+    else if ((c & 0xF8) == 0xF0) { cp = c & 0x07; n = 3; }
+    else { ++p; continue; }  // invalid lead → U+FFFD → delete
+    bool ok = (p + n < len);
+    for (int j = 1; ok && j <= n; ++j) {
+      if ((buf[p + j] & 0xC0) != 0x80) ok = false;
+      else cp = (cp << 6) | (buf[p + j] & 0x3F);
+    }
+    if (!ok || cp > 0x10FFFF || (cp >= 0xD800 && cp <= 0xDFFF) ||
+        (n == 1 && cp < 0x80) || (n == 2 && cp < 0x800) || (n == 3 && cp < 0x10000)) {
+      ++p;
+      continue;
+    }
+    uint8_t cls = cpclass[cp];
+    if (cls == 1) {  // word codepoint: original bytes, hashed verbatim
+      for (int j = 0; j <= n; ++j) {
+        uint8_t wc = buf[p + j];
+        words_out[words_len + wlen] = wc;
+        ++wlen;
+        h1 = h1 * H1_MULT + wc + 1;
+        h2 = h2 * H2_MULT + wc + 1;
+      }
+    } else if (cls == 2) {
+      if (!flush()) return -1;
+    }
+    p += n + 1;
+  }
+  if (!flush()) return -1;
+  return n_unique;
+}
+
 // Normalize raw UTF-8 in one pass (the C replacement for
 // core/normalize.normalize_unicode — byte-exact by contract, proven by
 // tests/test_native.py):

@@ -76,7 +76,21 @@ def sharded_empty_state(mesh: Mesh, capacity_per_shard: int) -> KVBatch:
     return jax.device_put(stacked, state_sharding(mesh))
 
 
+_SHUFFLE_FNS: dict = {}  # (app, u_cap, bucket_cap, mesh) → (map_shuffle, merge)
+
+
 def make_shuffle_step_fns(app: App, u_cap: int, bucket_cap: int, mesh: Mesh):
+    """Cached wrapper: apps are frozen dataclasses and Mesh hashes by value,
+    so repeated run_job calls in one process reuse the jitted closures
+    (and therefore jax.jit's executable cache) instead of recompiling."""
+    key = (app, u_cap, bucket_cap, mesh)
+    fns = _SHUFFLE_FNS.get(key)
+    if fns is None:
+        fns = _SHUFFLE_FNS[key] = _build_shuffle_step_fns(app, u_cap, bucket_cap, mesh)
+    return fns
+
+
+def _build_shuffle_step_fns(app: App, u_cap: int, bucket_cap: int, mesh: Mesh):
     """(map_shuffle, merge) — the group-of-D-chunks mesh pipeline.
 
     map_shuffle: chunks [D, chunk_bytes], doc_ids [D] →
@@ -118,6 +132,13 @@ def make_shuffle_step_fns(app: App, u_cap: int, bucket_cap: int, mesh: Mesh):
         )
         flat = KVBatch(*(x.reshape(-1) for x in recv))  # [d * bucket_cap]
         local = count_unique(flat, op=op)  # distinct keys of MY hash class
+        # If ANY chip overflowed (u_cap truncation or bucket skew), the
+        # whole group clamps to empty — every chip must agree, hence the
+        # psum — and the driver replays it through a wider tier. This lets
+        # the merge dispatch before the flags reach the host, so the stream
+        # loop batches its readbacks into one RPC per pipeline window.
+        bad = jax.lax.psum(p_ovf + b_ovf, AXIS) > 0
+        local = local._replace(valid=local.valid & ~bad)
         return (
             KVBatch(*(x[None] for x in local)),
             p_ovf[None],

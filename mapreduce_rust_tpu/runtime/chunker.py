@@ -57,6 +57,19 @@ def _ws_cut(data: bytes, start: int, end: int) -> tuple[int, bool]:
     return cut, False
 
 
+def utf8_safe_cut(data: bytes, cut: int) -> int:
+    """Largest cut' <= cut that does not split a UTF-8 sequence: back off
+    past trailing continuation bytes and their lead byte (a complete
+    trailing sequence also moves whole past the cut). Shared force-cut
+    policy of every byte-stream splitter (chunk_stream, the host engine's
+    window iterator) so the engines can never diverge on it."""
+    while cut > 1 and (data[cut - 1] & 0xC0) == 0x80:
+        cut -= 1
+    if cut > 1 and data[cut - 1] >= 0xC0:
+        cut -= 1
+    return cut
+
+
 def split_points(data: bytes, chunk_bytes: int) -> list[tuple[int, int, bool]]:
     """(start, end, forced) payload spans, each <= chunk_bytes.
 
@@ -118,13 +131,8 @@ def chunk_stream(
             if forced_window:
                 # No whitespace in the whole window: cut anyway, but at a
                 # UTF-8 sequence boundary so per-window normalization
-                # matches whole-file normalization byte for byte. Back off
-                # past any trailing continuation bytes and their lead byte —
-                # a complete trailing sequence also moves whole into carry.
-                while cut > 1 and (buf[cut - 1] & 0xC0) == 0x80:
-                    cut -= 1
-                if cut > 1 and buf[cut - 1] >= 0xC0:
-                    cut -= 1
+                # matches whole-file normalization byte for byte.
+                cut = utf8_safe_cut(buf, cut)
             raw_carry = buf[cut:]
             buf = buf[:cut]
         data = pending + (normalize_unicode(buf) if normalize else buf)

@@ -20,8 +20,11 @@ state. That collective IS the reference's mr-{m}-{r}.txt file shuffle
 
 The loop is pipelined: JAX dispatch is async, so while the device works on
 chunk k the host normalizes/chunks k+1 and feeds the egress dictionary
-(runtime/dictionary.py). Device sync points trail dispatch by two steps
-(overflow/spill counters), so the device never idles on the host.
+(runtime/dictionary.py). Overflow/spill counters come back via async
+device→host copies issued at dispatch and read ``Config.pipeline_depth``
+steps later, so the host never blocks a round trip per chunk — essential
+when the chip sits behind a tunnel where one blocking scalar read costs
+~80 ms against sub-ms step compute.
 
 Capacity faults are handled, not asserted (VERDICT r1 weak 3):
 - per-chunk distinct keys > partial_capacity → the chunk/group is
@@ -60,7 +63,30 @@ from mapreduce_rust_tpu.runtime.chunker import chunk_stream, list_inputs
 from mapreduce_rust_tpu.runtime.dictionary import Dictionary
 from mapreduce_rust_tpu.runtime.metrics import JobStats, log
 
-_PIPELINE_DEPTH = 2  # device sync trails dispatch by this many steps
+_cc_enabled = False
+
+
+def enable_compilation_cache(path: str | None = "auto") -> None:
+    """Point XLA's persistent compilation cache at a shared directory.
+
+    Idempotent (first caller wins). The step-fn compiles below are tens of
+    seconds each on TPU; with this cache a *process* pays them at most once
+    ever per (shape, backend) instead of once per run — the difference
+    between a bench that times out and one that measures steady state.
+    "auto" resolves to <repo>/.jax_cache next to the package.
+    """
+    global _cc_enabled
+    if _cc_enabled or not path:
+        return
+    if path == "auto":
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+            ".jax_cache",
+        )
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    _cc_enabled = True
 
 
 def select_device(kind: str = "auto"):
@@ -73,13 +99,30 @@ def select_device(kind: str = "auto"):
     return devs[0]
 
 
+_STEP_FNS: dict = {}  # (app, u_cap) → (map_combine, merge)
+
+
 def make_step_fns(app: App, u_cap: int):
     """(map_combine, merge) jitted for one app + update capacity.
 
     map_combine: chunk bytes → compacted per-chunk partial + overflow count.
     merge: fold the partial into the running state, returning the evicted
     tail and its record count (donates the old state's buffers).
+
+    Cached at module level: apps are frozen dataclasses, so (app, u_cap) is
+    a value key and every run_job in a process shares one set of jitted
+    closures — a second run hits jax.jit's in-process executable cache
+    instead of recompiling (the round-3 bench killer: warm == cold because
+    fresh closures were built per call).
     """
+    key = (app, u_cap)
+    fns = _STEP_FNS.get(key)
+    if fns is None:
+        fns = _STEP_FNS[key] = _build_step_fns(app, u_cap)
+    return fns
+
+
+def _build_step_fns(app: App, u_cap: int):
     op = app.combine_op
 
     @jax.jit
@@ -89,6 +132,12 @@ def make_step_fns(app: App, u_cap: int):
         partial = count_unique(kv, op=op)
         update = partial.take_front(u_cap)
         ovf = jnp.sum(partial.valid[u_cap:].astype(jnp.int32))
+        # An overflowing chunk contributes NOTHING (update clamps to empty):
+        # the driver replays it full-width later. This makes the merge safe
+        # to dispatch before the overflow flag ever reaches the host, which
+        # is what lets the stream loop batch its readbacks (one device→host
+        # round trip per pipeline window, not per chunk).
+        update = update._replace(valid=update.valid & (ovf == 0))
         return update, ovf
 
     @functools.partial(jax.jit, donate_argnums=(0,))
@@ -101,30 +150,61 @@ def make_step_fns(app: App, u_cap: int):
 
 
 class HostAccumulator:
-    """Exact host-side fold of device spills + the final state, per op."""
+    """Exact host-side fold of device spills + the final state, per op.
+
+    Adds are O(1) array appends; the fold is deferred and vectorized
+    (np.unique over the concatenated batches + ufunc.at), so a spill-heavy
+    run costs one sort at egress instead of per-record Python per spill.
+    The per-key Python dict is built exactly once, when .table is read.
+    """
 
     def __init__(self, op: str) -> None:
         self.op = op
-        self.table: dict = (
-            collections.defaultdict(set) if op == "distinct" else {}
-        )
+        self._keys: list[np.ndarray] = []   # each [N, 2] int64
+        self._vals: list[np.ndarray] = []   # each [N] int64
+        self._table: dict | None = None
 
     def add(self, keys: np.ndarray, vals: np.ndarray) -> None:
-        op, t = self.op, self.table
-        for (a, b), v in zip(keys.tolist(), vals.tolist()):
-            k = (a, b)
-            if op == "sum":
-                t[k] = t.get(k, 0) + v
-            elif op == "distinct":
-                t[k].add(v)
-            elif op == "max":
-                t[k] = v if k not in t else max(t[k], v)
-            else:
-                t[k] = v if k not in t else min(t[k], v)
+        keys = np.asarray(keys, dtype=np.int64).reshape(-1, 2)
+        if len(keys):
+            self._keys.append(keys)
+            self._vals.append(np.asarray(vals, dtype=np.int64).reshape(-1))
+            self._table = None  # late add after a read: refold lazily
 
     def add_batch(self, batch: KVBatch) -> None:
         keys, vals = batch.to_host()
         self.add(keys, vals)
+
+    @property
+    def table(self) -> dict:
+        if self._table is None:
+            self._table = self._fold()
+        return self._table
+
+    def _fold(self) -> dict:
+        if not self._keys:
+            return {}
+        keys = np.concatenate(self._keys)
+        vals = np.concatenate(self._vals)
+        if self.op == "distinct":
+            # Rows are (k1, k2, value); unique rows ARE the distinct fold.
+            rows = np.unique(np.column_stack([keys, vals]), axis=0)
+            t: dict = collections.defaultdict(set)
+            for a, b, v in rows.tolist():
+                t[(a, b)].add(v)
+            return t
+        uniq, inv = np.unique(keys, axis=0, return_inverse=True)
+        inv = inv.reshape(-1)
+        if self.op == "sum":
+            folded = np.zeros(len(uniq), dtype=np.int64)
+            np.add.at(folded, inv, vals)
+        elif self.op == "max":
+            folded = np.full(len(uniq), np.iinfo(np.int64).min)
+            np.maximum.at(folded, inv, vals)
+        else:
+            folded = np.full(len(uniq), np.iinfo(np.int64).max)
+            np.minimum.at(folded, inv, vals)
+        return {(a, b): v for (a, b), v in zip(map(tuple, uniq.tolist()), folded.tolist())}
 
 
 @dataclasses.dataclass
@@ -168,6 +248,7 @@ class _IngestStream:
         from concurrent.futures import ThreadPoolExecutor
 
         self.cfg = cfg
+        self.stats = stats
         self.dictionary = dictionary
         self.workers = max(cfg.ingest_threads, 1)
         self.pool = ThreadPoolExecutor(max_workers=self.workers)
@@ -217,7 +298,9 @@ class _IngestStream:
 
     def __iter__(self):
         while True:
+            t0 = time.perf_counter()
             chunk = self.q.get()
+            self.stats.ingest_wait_s += time.perf_counter() - t0
             if chunk is _SENTINEL:
                 if self.err is not None:
                     raise self.err
@@ -252,37 +335,52 @@ class _IngestStream:
 
 def _stream_single(cfg: Config, app: App, inputs, stats, acc, dictionary,
                    doc_id_offset: int = 0) -> None:
+    enable_compilation_cache(cfg.compilation_cache_dir)
     device = select_device(cfg.device)
     u_cap = cfg.effective_partial_capacity()
+    depth = max(cfg.pipeline_depth, 1)
     map_combine, merge = make_step_fns(app, u_cap)
     slow_fns = None  # full-width replay path, compiled only if ever needed
 
     state = jax.device_put(KVBatch.empty(cfg.merge_capacity), device)
-    mc_pending: collections.deque = collections.deque()
-    sp_pending: collections.deque = collections.deque()
+    pending: collections.deque = collections.deque()  # (ovf, ev_count, evicted, chunk_host, did)
 
-    def resolve_map_combine() -> None:
+    def replay_chunk(chunk_host: np.ndarray, doc_id) -> None:
+        # More distinct keys in the chunk than partial_capacity: the fast
+        # path clamped its update to empty (make_step_fns), so re-run the
+        # whole chunk at full width. Exact, never silent (VERDICT r1 weak 3).
         nonlocal state, slow_fns
-        update, ovf, chunk_dev, doc_id = mc_pending.popleft()
-        this_merge = merge
-        if int(ovf) > 0:
-            # More distinct keys in the chunk than partial_capacity: replay
-            # at full width. Exact, never silent (VERDICT r1 weak 3).
-            stats.partial_overflow_replays += 1
-            if slow_fns is None:
-                slow_fns = make_step_fns(app, cfg.chunk_bytes)
-            update, _ = slow_fns[0](chunk_dev, doc_id)
-            this_merge = slow_fns[1]
-        state, evicted, ev_count = this_merge(state, update)
-        sp_pending.append((evicted, ev_count))
-
-    def resolve_spill() -> None:
-        evicted, ev_count = sp_pending.popleft()
-        n = int(ev_count)
-        if n > 0:
+        stats.partial_overflow_replays += 1
+        if slow_fns is None:
+            slow_fns = make_step_fns(app, cfg.chunk_bytes)
+        update, _ = slow_fns[0](jax.device_put(chunk_host, device), doc_id)
+        state, evicted, ev_count = slow_fns[1](state, update)
+        if int(ev_count) > 0:
             stats.spill_events += 1
-            stats.spilled_keys += n
+            stats.spilled_keys += int(ev_count)
             acc.add_batch(evicted)
+
+    def drain(n: int) -> None:
+        # Resolve the oldest n pipeline steps with ONE batched readback:
+        # through a tunneled TPU every device→host read costs a ~80 ms
+        # round trip no matter its size, so per-chunk scalar reads cap the
+        # stream at ~12 chunks/s. One device_get for the whole window pays
+        # that latency once per `pipeline_depth` chunks.
+        if n <= 0:
+            return
+        batch = [pending.popleft() for _ in range(n)]
+        t0 = time.perf_counter()
+        flat = jax.device_get([x for (ovf, evc, *_rest) in batch for x in (ovf, evc)])
+        stats.device_wait_s += time.perf_counter() - t0
+        for (ovf, evc, evicted, chunk_host, did), ovf_n, ev_n in zip(
+            batch, flat[::2], flat[1::2]
+        ):
+            if int(ev_n) > 0:
+                stats.spill_events += 1
+                stats.spilled_keys += int(ev_n)
+                acc.add_batch(evicted)
+            if int(ovf_n) > 0:
+                replay_chunk(chunk_host, did)
 
     ingest = _IngestStream(cfg, inputs, stats, dictionary, doc_id_offset)
     try:
@@ -290,19 +388,220 @@ def _stream_single(cfg: Config, app: App, inputs, stats, acc, dictionary,
             chunk_dev = jax.device_put(chunk.data, device)
             did = jax.device_put(np.int32(chunk.doc_id), device)
             update, ovf = map_combine(chunk_dev, did)
-            mc_pending.append((update, ovf, chunk_dev, did))
-            if len(mc_pending) > _PIPELINE_DEPTH:
-                resolve_map_combine()
-            if len(sp_pending) > _PIPELINE_DEPTH:
-                resolve_spill()
-        while mc_pending:
-            resolve_map_combine()
-        while sp_pending:
-            resolve_spill()
+            # Merge dispatches immediately — an overflowed update is empty
+            # on device, so merging before the flag reaches the host is safe.
+            state, evicted, ev_count = merge(state, update)
+            pending.append((ovf, ev_count, evicted, chunk.data, did))
+            # Keep one window in flight while draining the previous one, so
+            # the batched readback's round trip overlaps dispatched work.
+            if len(pending) >= 2 * depth:
+                drain(depth)
+        drain(len(pending))
     except BaseException:
         ingest.close(abort=True)
         raise
     ingest.close()
+    acc.add_batch(state)
+
+
+_PACKED_FNS: dict = {}  # (app, cap) → merge_packed
+
+
+def make_packed_merge_fn(app: App, cap: int):
+    """Merge one host-mapped update, shipped as ONE flat uint32 array
+    (host→device transfers through a tunneled chip pay a big fixed round
+    trip, so the four KVBatch leaves must not be four transfers):
+
+        flat[0]           n — number of real records
+        flat[1 : 1+cap]   k1 (SENTINEL-padded so padding sorts last)
+        flat[1+cap : 1+2cap]  k2
+        flat[1+2cap : 1+3cap] value (uint32 bit-pattern of the int32)
+
+    Returns (new_state, evicted, evicted_count), donating the old state —
+    the host-engine twin of _build_step_fns.merge.
+    """
+    key = (app, cap)
+    fn = _PACKED_FNS.get(key)
+    if fn is not None:
+        return fn
+    op = app.combine_op
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def merge_packed(state: KVBatch, flat: jnp.ndarray):
+        n = flat[0].astype(jnp.int32)
+        update = KVBatch(
+            k1=flat[1 : 1 + cap],
+            k2=flat[1 + cap : 1 + 2 * cap],
+            value=flat[1 + 2 * cap : 1 + 3 * cap].astype(jnp.int32),
+            valid=jnp.arange(cap, dtype=jnp.int32) < n,
+        )
+        new_state, evicted = merge_batches(state, update, op=op)
+        ev_count = jnp.sum(evicted.valid.astype(jnp.int32))
+        return new_state, evicted, ev_count
+
+    _PACKED_FNS[key] = merge_packed
+    return merge_packed
+
+
+def _pack_update(keys: np.ndarray, values: np.ndarray, cap: int) -> np.ndarray:
+    """Lay one window's (keys uint32[n,2], values) into the flat layout
+    make_packed_merge_fn expects."""
+    n = len(keys)
+    flat = np.full(1 + 3 * cap, 0xFFFFFFFF, dtype=np.uint32)  # SENTINEL pad
+    flat[0] = n
+    flat[1 : 1 + n] = keys[:, 0]
+    flat[1 + cap : 1 + cap + n] = keys[:, 1]
+    flat[1 + 2 * cap : 1 + 2 * cap + n] = np.asarray(values, dtype=np.uint32)
+    return flat
+
+
+def _iter_windows(cfg: Config, inputs, stats):
+    """(doc_id, raw window bytes) stream, cut at ASCII whitespace (safe
+    before normalization — normalize never alters ASCII bytes), read ahead
+    by one window on a prefetch thread. A token longer than the window is
+    force-cut at a UTF-8 sequence boundary and counted in
+    stats.forced_cuts — the same policy (and caveat) as the device
+    engine's chunker (runtime/chunker.py). Abandoning the generator stops
+    the producer and closes its file (no thread/fd leak)."""
+    import queue
+    import threading
+
+    from mapreduce_rust_tpu.runtime.chunker import _ws_cut, utf8_safe_cut
+
+    q: "queue.Queue" = queue.Queue(maxsize=2)
+    stop = threading.Event()
+
+    def put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def produce() -> None:
+        try:
+            for doc_id, path in enumerate(inputs):
+                stats.bytes_in += os.path.getsize(path)
+                carry = b""
+                with open(path, "rb") as f:
+                    while True:
+                        block = f.read(cfg.host_window_bytes)
+                        if not block:
+                            if carry and not put((doc_id, carry)):
+                                return
+                            break
+                        buf = carry + block
+                        cut, forced = _ws_cut(buf, 0, len(buf))
+                        if forced:
+                            # One giant token: force-cut, never inside a
+                            # UTF-8 sequence (shared chunker policy).
+                            stats.forced_cuts += 1
+                            cut = utf8_safe_cut(buf, cut)
+                        carry = buf[cut:]
+                        if not put((doc_id, buf[:cut])):
+                            return
+            put(_SENTINEL)
+        except BaseException as e:
+            put(e)
+
+    thread = threading.Thread(target=produce, daemon=True)
+    thread.start()
+    try:
+        while True:
+            t0 = time.perf_counter()
+            item = q.get()
+            stats.ingest_wait_s += time.perf_counter() - t0
+            if item is _SENTINEL:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass
+        thread.join(timeout=5)
+
+
+def _py_scan_count(window: bytes):
+    """Pure-Python fallback for scan_count_raw (no native toolchain):
+    exact, an order of magnitude slower. The window is RAW bytes, so it
+    normalizes first — the fused C pass does both in one sweep."""
+    from mapreduce_rust_tpu.core.hashing import hash_words
+    from mapreduce_rust_tpu.core.normalize import normalize_unicode
+    from mapreduce_rust_tpu.runtime.dictionary import extract_words
+
+    counter = collections.Counter(extract_words(normalize_unicode(window)))
+    words = list(counter.keys())
+    keys = hash_words(words)
+    counts = np.asarray([counter[w] for w in words], dtype=np.uint32)
+    return words, keys, counts
+
+
+def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
+                     doc_id_offset: int = 0) -> None:
+    """The host-map engine: one fused native pass per window tokenizes,
+    dedupes, hashes and counts on the host — the very scan that feeds the
+    egress dictionary — and the device merges the compacted updates. The
+    map lives where the reference's map lives (the worker CPU,
+    src/app/wc.rs:6-13); the framework's added value is the device-side
+    combine/merge/shuffle state machine behind it. End-to-end this beats
+    the device-tokenize engine whenever host→device bandwidth, not
+    compute, is the ceiling (measured: a tunneled v5e moves ~60 MB/s of
+    chunk bytes but >100 MB/s of text through the host scan, whose updates
+    are 10-30× smaller than the text)."""
+    from mapreduce_rust_tpu.native.host import scan_count_raw
+
+    enable_compilation_cache(cfg.compilation_cache_dir)
+    device = select_device(cfg.device)
+    depth = max(cfg.pipeline_depth, 1)
+    state = jax.device_put(KVBatch.empty(cfg.merge_capacity), device)
+    pending: collections.deque = collections.deque()  # (ev_count, evicted)
+
+    def drain(n: int) -> None:
+        # One batched readback per window batch — see _stream_single.drain.
+        if n <= 0:
+            return
+        batch = [pending.popleft() for _ in range(n)]
+        t0 = time.perf_counter()
+        counts = jax.device_get([ev for ev, _ in batch])
+        stats.device_wait_s += time.perf_counter() - t0
+        for (ev, evicted), ev_n in zip(batch, counts):
+            if int(ev_n) > 0:
+                stats.spill_events += 1
+                stats.spilled_keys += int(ev_n)
+                acc.add_batch(evicted)
+
+    for doc_id, window in _iter_windows(cfg, inputs, stats):
+        stats.chunks += 1
+        res = scan_count_raw(window)
+        if res is not None:
+            raw, ends, keys, counts = res
+            dictionary.add_scanned_raw(raw, ends, keys)
+        else:
+            words, keys, counts = _py_scan_count(window)
+            dictionary.add_scanned(words, keys)
+        values = app.host_values(counts, doc_id_offset + doc_id)
+        # Fixed update capacity, splitting big windows across merges: ONE
+        # compiled merge shape for the whole run (a variable cap means a
+        # ragged tail window triggers a fresh multi-10s XLA compile).
+        cap = cfg.host_update_cap
+        merge_packed = make_packed_merge_fn(app, cap)
+        for start in range(0, len(keys), cap):
+            flat = jax.device_put(
+                _pack_update(keys[start : start + cap], values[start : start + cap], cap),
+                device,
+            )
+            state, evicted, ev_count = merge_packed(state, flat)
+            pending.append((ev_count, evicted))
+        if len(pending) >= 2 * depth:
+            drain(depth)
+    drain(len(pending))
     acc.add_batch(state)
 
 
@@ -317,6 +616,7 @@ def _stream_mesh(cfg: Config, app: App, inputs, stats, acc, dictionary) -> None:
         sharded_empty_state,
     )
 
+    enable_compilation_cache(cfg.compilation_cache_dir)
     backend = None if cfg.device == "auto" else cfg.device
     mesh = make_mesh(cfg.mesh_shape, backend)
     d = mesh.devices.size
@@ -327,13 +627,20 @@ def _stream_mesh(cfg: Config, app: App, inputs, stats, acc, dictionary) -> None:
 
     state = sharded_empty_state(mesh, max(cfg.merge_capacity // d, 16))
     in_shard = NamedSharding(mesh, P(AXIS))
-    mc_pending: collections.deque = collections.deque()
-    sp_pending: collections.deque = collections.deque()
+    # Each in-flight group pins d chunk-sized host arrays for the rare
+    # replay, so scale the window down by d to keep pending memory at the
+    # same O(depth × chunk_bytes) the single-chip path pays.
+    depth = max(max(cfg.pipeline_depth, 1) // d, 4)
+    pending: collections.deque = collections.deque()
 
-    def resolve_group() -> None:
+    def replay_group(chunks_host, docs_host, p_ovf_n: int) -> None:
+        # The fast path clamped this whole group to empty on device
+        # (make_shuffle_step_fns psum clamp), so re-run it through a tier
+        # wide enough that the overflow cannot recur, and merge that.
         nonlocal state
-        local, p_ovf, b_ovf, chunks_dev, docs_dev, fns = mc_pending.popleft()
-        if int(jnp.sum(p_ovf)) > 0:
+        chunks_dev = jax.device_put(chunks_host, in_shard)
+        docs_dev = jax.device_put(docs_host, in_shard)
+        if p_ovf_n > 0:
             # A chunk had more distinct keys than u_cap: widest tier.
             stats.partial_overflow_replays += 1
             if "full" not in tiers:
@@ -341,42 +648,64 @@ def _stream_mesh(cfg: Config, app: App, inputs, stats, acc, dictionary) -> None:
                     app, cfg.chunk_bytes, cfg.chunk_bytes, mesh
                 )
             fns = tiers["full"]
-            local, _, _ = fns[0](chunks_dev, docs_dev)
-        elif int(jnp.sum(b_ovf)) > 0:
+        else:
             # Bucket skew: bucket_cap=u_cap makes overflow impossible.
             stats.bucket_skew_replays += 1
             if "skew" not in tiers:
                 tiers["skew"] = make_shuffle_step_fns(app, u_cap, u_cap, mesh)
             fns = tiers["skew"]
-            local, _, _ = fns[0](chunks_dev, docs_dev)
+        local, _, _ = fns[0](chunks_dev, docs_dev)
         state, evicted, ev_counts = fns[1](state, local)
-        sp_pending.append((evicted, ev_counts))
-
-    def resolve_spill() -> None:
-        evicted, ev_counts = sp_pending.popleft()
-        n = int(jnp.sum(ev_counts))
-        if n > 0:
+        ev_n = int(np.asarray(jax.device_get(ev_counts)).sum())
+        if ev_n > 0:
             stats.spill_events += 1
-            stats.spilled_keys += n
+            stats.spilled_keys += ev_n
             acc.add_batch(evicted)
+
+    def drain(n: int) -> None:
+        # One batched readback per window — see _stream_single.drain.
+        if n <= 0:
+            return
+        batch = [pending.popleft() for _ in range(n)]
+        t0 = time.perf_counter()
+        flat = jax.device_get(
+            [x for (p, b, e, *_rest) in batch for x in (p, b, e)]
+        )
+        stats.device_wait_s += time.perf_counter() - t0
+        for (p, b, e, evicted, chunks_host, docs_host), p_arr, b_arr, e_arr in zip(
+            batch, flat[::3], flat[1::3], flat[2::3]
+        ):
+            ev_n = int(np.asarray(e_arr).sum())
+            if ev_n > 0:
+                stats.spill_events += 1
+                stats.spilled_keys += ev_n
+                acc.add_batch(evicted)
+            p_n = int(np.asarray(p_arr).sum())
+            if p_n > 0 or int(np.asarray(b_arr).sum()) > 0:
+                replay_group(chunks_host, docs_host, p_n)
 
     group_chunks: list[np.ndarray] = []
     group_docs: list[int] = []
 
     def submit_group() -> None:
+        nonlocal state
         while len(group_chunks) < d:  # pad the tail group with space chunks
             group_chunks.append(np.full(cfg.chunk_bytes, 0x20, dtype=np.uint8))
             group_docs.append(0)
-        chunks_dev = jax.device_put(np.stack(group_chunks), in_shard)
-        docs_dev = jax.device_put(np.asarray(group_docs, dtype=np.int32), in_shard)
+        chunks_host = np.stack(group_chunks)
+        docs_host = np.asarray(group_docs, dtype=np.int32)
         group_chunks.clear()
         group_docs.clear()
-        local, p_ovf, b_ovf = fast[0](chunks_dev, docs_dev)
-        mc_pending.append((local, p_ovf, b_ovf, chunks_dev, docs_dev, fast))
-        if len(mc_pending) > _PIPELINE_DEPTH:
-            resolve_group()
-        if len(sp_pending) > _PIPELINE_DEPTH:
-            resolve_spill()
+        local, p_ovf, b_ovf = fast[0](
+            jax.device_put(chunks_host, in_shard), jax.device_put(docs_host, in_shard)
+        )
+        # Merge dispatches immediately — an overflowed group is empty on
+        # device, so merging before the flags reach the host is safe. Host
+        # arrays are kept for the rare replay instead of device buffers.
+        state, evicted, ev_counts = fast[1](state, local)
+        pending.append((p_ovf, b_ovf, ev_counts, evicted, chunks_host, docs_host))
+        if len(pending) >= 2 * depth:
+            drain(depth)
 
     ingest = _IngestStream(cfg, inputs, stats, dictionary)
     try:
@@ -387,10 +716,7 @@ def _stream_mesh(cfg: Config, app: App, inputs, stats, acc, dictionary) -> None:
                 submit_group()
         if group_chunks:
             submit_group()
-        while mc_pending:
-            resolve_group()
-        while sp_pending:
-            resolve_spill()
+        drain(len(pending))
     except BaseException:
         ingest.close(abort=True)
         raise
@@ -425,6 +751,8 @@ def run_job(
     with stats.phase("stream"), prof:
         if cfg.mesh_shape and cfg.mesh_shape > 1:
             _stream_mesh(cfg, app, inputs, stats, acc, dictionary)
+        elif cfg.map_engine == "host":
+            _stream_host_map(cfg, app, inputs, stats, acc, dictionary)
         else:
             _stream_single(cfg, app, inputs, stats, acc, dictionary)
 

@@ -32,10 +32,21 @@ class JobStats:
     unknown_keys: int = 0         # final keys missing from the dictionary
     wall_seconds: float = 0.0
     phase_seconds: dict = dataclasses.field(default_factory=dict)
+    # Utilization split (who is the bottleneck): time the consumer loop sat
+    # idle waiting for host ingest (read→normalize→chunk) vs time it sat
+    # blocked on device results. ingest_wait ≫ device_wait → host-bound.
+    ingest_wait_s: float = 0.0
+    device_wait_s: float = 0.0
 
     @property
     def gb_per_s(self) -> float:
         return self.bytes_in / self.wall_seconds / 1e9 if self.wall_seconds else 0.0
+
+    @property
+    def bottleneck(self) -> str:
+        if not (self.ingest_wait_s or self.device_wait_s):
+            return "balanced"
+        return "host-ingest" if self.ingest_wait_s >= self.device_wait_s else "device"
 
     @contextmanager
     def phase(self, name: str):
@@ -56,5 +67,6 @@ class JobStats:
             f"spills={self.spill_events}({self.spilled_keys} keys) "
             f"replays={self.partial_overflow_replays}+{self.bucket_skew_replays}skew "
             f"collisions={self.hash_collisions} unknown={self.unknown_keys} "
-            f"[{phases}]"
+            f"waits[ingest={self.ingest_wait_s:.2f}s device={self.device_wait_s:.2f}s "
+            f"→ {self.bottleneck}] [{phases}]"
         )

@@ -152,3 +152,64 @@ def test_app_registry():
     assert get_app("top_k", k=5).k == 5
     with pytest.raises(ValueError):
         get_app("nope")
+
+
+# ---- host-map engine (fused native scan + device merge) ----
+
+
+def host_cfg(tmp_path, **kw) -> Config:
+    defaults = dict(
+        map_engine="host",
+        host_window_bytes=4096,
+        host_update_cap=256,       # force multi-merge splits per window
+        merge_capacity=1 << 14,
+        reduce_n=4,
+        output_dir=str(tmp_path / "out"),
+        device="cpu",
+    )
+    defaults.update(kw)
+    return Config(**defaults)
+
+
+def test_host_engine_matches_oracle_and_device_engine(tmp_path):
+    texts = [SMALL_TEXT, SMALL_TEXT[: len(SMALL_TEXT) // 3] + " zebra zebra"]
+    paths = write_inputs(tmp_path, texts)
+    host = run_job(host_cfg(tmp_path), paths, write_outputs=False)
+    device = run_job(small_cfg(tmp_path), paths, write_outputs=False)
+    assert host.table == device.table == oracle_counts(texts)
+    assert host.stats.unknown_keys == 0
+
+
+def test_host_engine_spill_path_exact(tmp_path):
+    # merge_capacity far below distinct keys: every merge evicts, and the
+    # host accumulator must reconstruct exact totals from the spills.
+    words = " ".join(f"w{i:05d}" for i in range(3000)) + " common" * 7
+    paths = write_inputs(tmp_path, [words * 3])
+    cfg = host_cfg(tmp_path, merge_capacity=256)
+    res = run_job(cfg, paths, write_outputs=False)
+    assert res.table == oracle_counts([words * 3])
+    assert res.stats.spill_events > 0
+
+
+def test_host_engine_inverted_index(tmp_path):
+    texts = ["alpha beta gamma", "beta gamma delta", "gamma delta epsilon alpha"]
+    paths = write_inputs(tmp_path, texts)
+    res = run_job(host_cfg(tmp_path), paths, app=InvertedIndex(), write_outputs=False)
+    oracle = {}
+    for d, t in enumerate(texts):
+        for w in set(t.split()):
+            oracle.setdefault(w.encode(), set()).add(d)
+    assert res.table == {w: sorted(s) for w, s in oracle.items()}
+
+
+def test_host_engine_python_fallback(tmp_path, monkeypatch):
+    # No native lib → the pure-Python scan path must stay exact.
+    import mapreduce_rust_tpu.runtime.driver as drv
+
+    monkeypatch.setattr(
+        "mapreduce_rust_tpu.native.host.scan_count_raw", lambda data: None
+    )
+    texts = [SMALL_TEXT]
+    paths = write_inputs(tmp_path, texts)
+    res = run_job(host_cfg(tmp_path), paths, write_outputs=False)
+    assert res.table == oracle_counts(texts)

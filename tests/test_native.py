@@ -102,3 +102,66 @@ def test_dictionary_native_equals_python_path(monkeypatch):
     d_python.add_text(text)
     assert dict(d_native.items()) == dict(d_python.items())
     assert len(d_native) == len(d_python) > 0
+
+
+def test_scan_count_raw_fused_equals_two_pass():
+    from mapreduce_rust_tpu.native.host import (
+        normalize_native,
+        scan_count_raw,
+        scan_unique_raw,
+    )
+
+    raw = (CORPUS / "gut-2.txt").read_bytes() if CORPUS.exists() else (
+        "mixed — “text” naïve repeat repeat don’t x_1 ".encode() * 2000
+    )
+    fused = scan_count_raw(raw)
+    assert fused is not None
+    words, ends, keys, counts = fused
+    two_pass = scan_unique_raw(normalize_native(raw))
+    assert words == two_pass[0]
+    assert np.array_equal(ends, two_pass[1])
+    assert np.array_equal(keys, two_pass[2])
+
+
+def test_scan_count_raw_counts_match_oracle():
+    from mapreduce_rust_tpu.core.normalize import reference_word_counts
+    from mapreduce_rust_tpu.native.host import scan_count_raw
+
+    raw = (CORPUS / "gut-2.txt").read_bytes() if CORPUS.exists() else (
+        "alpha beta alpha gamma don’t “alpha” naïve 42 beta ".encode() * 300
+    )
+    words, ends, keys, counts = scan_count_raw(raw)
+    oracle = reference_word_counts(raw)
+    first = next(iter(oracle))
+    enc = (lambda w: w) if isinstance(first, bytes) else (lambda w: w.encode())
+    got = {}
+    start = 0
+    for end, c in zip(ends.tolist(), counts.tolist()):
+        got[bytes(words[start:end])] = c
+        start = end
+    assert got == {enc(w): c for w, c in oracle.items()}
+
+
+@pytest.mark.parametrize(
+    "raw",
+    [
+        b"",
+        b"   \t\n ",
+        b"caf\xc3\xa9 caf\xc3\xa9 na\xc3\xafve",
+        b"a\xff\xfeb c\xc3",          # malformed UTF-8 → per-byte replace/delete
+        "日本 語 日本".encode(),
+        b"don't stop-me_now 42 42 42",
+    ],
+)
+def test_scan_count_raw_edges(raw):
+    from mapreduce_rust_tpu.native.host import (
+        normalize_native,
+        scan_count_raw,
+        scan_unique_raw,
+    )
+
+    fused = scan_count_raw(raw)
+    two_pass = scan_unique_raw(normalize_native(raw))
+    assert fused[0] == two_pass[0]
+    assert np.array_equal(fused[2], two_pass[2])
+    assert int(fused[3].sum()) >= len(fused[1])  # every unique occurs >= once
