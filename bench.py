@@ -39,7 +39,9 @@ import time
 REPO = pathlib.Path(__file__).resolve().parent
 REF_DATA = pathlib.Path("/root/reference/src/data")
 BENCH_DIR = REPO / ".bench"
-TARGET_MB = int(os.environ.get("BENCH_TARGET_MB", "128"))
+TARGET_MB = int(os.environ.get("BENCH_TARGET_MB", "512"))  # big enough that
+# one-time costs (state fetch, finalize, egress) amortize into the rate,
+# small enough to stay page-cache-resident next to the CPU baseline run
 BASELINE_MB = int(os.environ.get("BENCH_BASELINE_MB", "32"))
 FALLBACK_MB = int(os.environ.get("BENCH_FALLBACK_MB", "16"))
 DEVICE_TIMEOUT_S = int(os.environ.get("BENCH_DEVICE_TIMEOUT_S", "300"))
@@ -144,6 +146,7 @@ def device_leg(path: str) -> None:
         "ingest_wait_s": round(s.ingest_wait_s, 3),
         "device_wait_s": round(s.device_wait_s, 3),
         "bottleneck": s.bottleneck,
+        "host_map_s": round(s.host_map_s, 3),
         "map_engine": cfg.map_engine,
         "phases": {k: round(v, 3) for k, v in s.phase_seconds.items()},
         "platform": _platform_name(),
@@ -195,12 +198,27 @@ def main() -> None:
         corpus = build_corpus(8)
 
     try:
-        base_gbs = cpu_baseline_gbs(corpus, min(BASELINE_MB << 20, corpus.stat().st_size))
-        print(f"cpu baseline: {base_gbs:.4f} GB/s", file=sys.stderr)
+        # Median of three: the 1-core pool measurement is noisy (fork +
+        # import + scheduler jitter swing single runs ±20%).
+        runs = sorted(
+            cpu_baseline_gbs(corpus, min(BASELINE_MB << 20, corpus.stat().st_size))
+            for _ in range(3)
+        )
+        base_gbs = runs[1]
+        print(f"cpu baseline: {base_gbs:.4f} GB/s (runs: {runs})", file=sys.stderr)
     except Exception as e:
         errors.append(f"cpu_baseline: {e!r}")
 
+    # Median of three device runs — the SAME estimator as the CPU baseline
+    # (an asymmetric max-vs-median pairing would bias the ratio upward).
     dev, err = _run_device_subprocess(corpus, DEVICE_TIMEOUT_S, {})
+    if dev is not None:
+        more = [dev]
+        for _ in range(2):
+            r, _e = _run_device_subprocess(corpus, DEVICE_TIMEOUT_S, {})
+            if r is not None:
+                more.append(r)
+        dev = sorted(more, key=lambda r: r["gbs"])[len(more) // 2]
     if dev is None:
         errors.append(err)
         fallback = True

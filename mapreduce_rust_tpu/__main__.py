@@ -51,6 +51,7 @@ def _cfg(args, map_n: int = 1, worker_n: int = 1) -> Config:
         worker_n=worker_n,
         chunk_bytes=int(args.chunk_mb * (1 << 20)),
         device=args.device,
+        map_engine=getattr(args, "map_engine", "device"),
         mesh_shape=getattr(args, "mesh", None),
         profile_dir=args.profile_dir,
         host=args.host,
@@ -143,6 +144,11 @@ def main(argv: list[str] | None = None) -> int:
     p = sub.add_parser("run", help="single-process end-to-end job (TPU path)")
     _add_common(p)
     p.add_argument("--mesh", type=int, default=None, help="devices in the 1-D mesh")
+    p.add_argument("--map-engine", default="device", choices=["device", "host"],
+                   dest="map_engine",
+                   help="device: tokenize/combine fully on-chip; host: fused "
+                   "native scan maps on the host, device merges (fastest when "
+                   "host->device bandwidth is the bottleneck)")
 
     p = sub.add_parser("coordinator", help="control-plane scheduler")
     _add_common(p)

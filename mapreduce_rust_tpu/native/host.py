@@ -69,7 +69,7 @@ def get_lib() -> ctypes.CDLL | None:
             ]
             lib.mr_scan_count.restype = ctypes.c_int64
             lib.mr_scan_count.argtypes = [
-                ctypes.c_char_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
                 ctypes.POINTER(ctypes.c_uint8),
                 ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int64),
                 ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint32),
@@ -164,14 +164,17 @@ def _buffers(n: int, max_words: int):
 
 
 def scan_count_raw(
-    data: bytes,
+    data: "bytes | np.ndarray",
 ) -> tuple[bytes, np.ndarray, np.ndarray, np.ndarray] | None:
     """(concatenated unique words, int64[n] end offsets, uint32[n,2] hash
     pairs, uint32[n] occurrence counts) over RAW un-normalized UTF-8 — the
     fused one-pass map kernel of the host-map engine, or None when the
     native lib is unavailable. Byte-exact equivalent of
     normalize_unicode → scan_unique_raw plus per-word counting
-    (tests/test_native.py proves the equivalence)."""
+    (tests/test_native.py proves the equivalence).
+
+    Accepts bytes or a uint8 numpy view (e.g. a memory-mapped window) —
+    the view path copies nothing on the way in."""
     lib = get_lib()
     if lib is None:
         return None
@@ -181,13 +184,15 @@ def scan_count_raw(
         np.empty((0, 2), dtype=np.uint32),
         np.empty(0, dtype=np.uint32),
     )
-    if not data:
+    buf = data if isinstance(data, np.ndarray) else np.frombuffer(data, dtype=np.uint8)
+    buf = np.ascontiguousarray(buf, dtype=np.uint8)  # views stay zero-copy
+    n = int(buf.size)
+    if n == 0:
         return empty
-    n = len(data)
     max_words = n // 2 + 2
     words_buf, ends, k1, k2, counts = _buffers(n, max_words)
     count = lib.mr_scan_count(
-        data, n,
+        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), n,
         _cpclass().ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         words_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         ends.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),

@@ -17,6 +17,10 @@
 #include <cstring>
 #include <vector>
 
+#ifdef __AVX2__
+#include <immintrin.h>
+#endif
+
 namespace {
 
 constexpr uint32_t H1_MULT = 0x01000193u;  // FNV-1a prime
@@ -53,6 +57,37 @@ struct Slot {
   uint32_t prefix;  // first up-to-4 cleaned bytes, zero-padded
   int32_t len;      // 0 = slot unused
 };
+
+// Decode one UTF-8 sequence at buf[p] (caller guarantees buf[p] >= 0x80).
+// true  → valid codepoint: *cp set, *n = continuation bytes (advance n+1).
+// false → invalid (stray continuation, truncation, overlong, surrogate,
+//          out of range): caller advances by 1 and the byte is deleted —
+//          Python's errors="replace" per bad byte, then U+FFFD → delete.
+// THE single decode+validate used by every walker in this file
+// (mr_normalize, mr_scan_count's hash pass and its reclean): stored word
+// bytes are only correct if all walkers classify identically, so this
+// logic must never be duplicated.
+inline bool decode_utf8(const uint8_t* buf, int64_t len, int64_t p,
+                        uint32_t* cp, int* n) {
+  uint8_t c = buf[p];
+  uint32_t v = 0;
+  int k = 0;
+  if ((c & 0xE0) == 0xC0) { v = c & 0x1F; k = 1; }
+  else if ((c & 0xF0) == 0xE0) { v = c & 0x0F; k = 2; }
+  else if ((c & 0xF8) == 0xF0) { v = c & 0x07; k = 3; }
+  else return false;
+  bool ok = (p + k < len);
+  for (int j = 1; ok && j <= k; ++j) {
+    if ((buf[p + j] & 0xC0) != 0x80) ok = false;
+    else v = (v << 6) | (buf[p + j] & 0x3F);
+  }
+  if (!ok || v > 0x10FFFF || (v >= 0xD800 && v <= 0xDFFF) ||
+      (k == 1 && v < 0x80) || (k == 2 && v < 0x800) || (k == 3 && v < 0x10000))
+    return false;
+  *cp = v;
+  *n = k;
+  return true;
+}
 
 }  // namespace
 
@@ -184,9 +219,13 @@ int64_t mr_scan_count(const uint8_t* buf, int64_t len,
   // every probe into a DRAM miss; typical windows have far fewer uniques
   // than bytes/16, and growth amortizes for the ones that don't.
   int64_t cap = 1 << 15;
+  // 16-byte slot, four per cache line. Duplicate test is (k1, k2, len):
+  // the same ~2^-64 birthday bound that justifies keying the whole
+  // framework on the hash pair (SURVEY.md §7 hard part 3) — word bytes are
+  // not compared here (the hot loop no longer materializes them; see
+  // flush/reclean below). mr_scan_unique keeps its byte-prefix check.
   struct CSlot {
     uint32_t k1, k2;
-    uint32_t prefix;
     int32_t len;   // 0 = unused
     uint32_t idx;  // output index (counts_out[idx] is this word's count)
   };
@@ -214,16 +253,46 @@ int64_t mr_scan_count(const uint8_t* buf, int64_t len,
     cap = ncap;
   };
 
-  auto flush = [&]() -> bool {
+  // The hot loops hash WITHOUT materializing word bytes (the store per
+  // byte and its bookkeeping cost ~15% of the scan). tok_start remembers
+  // where the current token began in the RAW buffer; only when a key is
+  // first inserted does reclean() walk that span again to extract the
+  // cleaned bytes — re-walking is rare (once per unique word) and short.
+  int64_t tok_start = -1;
+
+  // Re-extract the cleaned word bytes of raw span [from, to) — the same
+  // classification walk as the hashing pass, emitting instead of hashing.
+  auto reclean = [&](int64_t from, int64_t to, uint8_t* dst) -> int64_t {
+    int64_t o = 0;
+    int64_t q = from;
+    while (q < to) {
+      uint8_t c = buf[q];
+      if (c < 0x80) {
+        if (kTables.cls[c] == 1) dst[o++] = c;
+        ++q;
+        continue;
+      }
+      uint32_t cp = 0;
+      int n = 0;
+      if (!decode_utf8(buf, len, q, &cp, &n)) {
+        ++q;
+        continue;
+      }
+      if (cpclass[cp] == 1)
+        for (int j = 0; j <= n; ++j) dst[o++] = buf[q + j];
+      q += n + 1;
+    }
+    return o;
+  };
+
+  // Close the current token whose raw span ends at `to` (exclusive).
+  auto flush = [&](int64_t to) -> bool {
     if (wlen == 0) {
       h1 = H1_INIT;
       h2 = H2_INIT;
       return true;
     }
     if (n_unique * 10 >= cap * 7) grow();
-    const uint8_t* cand = words_out + words_len;
-    uint32_t prefix = 0;
-    std::memcpy(&prefix, cand, (size_t)(wlen < 4 ? wlen : 4));
     uint64_t mask = (uint64_t)cap - 1;
     uint64_t i = (((uint64_t)h1 << 32) | h2) & mask;
     for (;;) {
@@ -232,10 +301,9 @@ int64_t mr_scan_count(const uint8_t* buf, int64_t len,
         if (n_unique >= max_words) return false;
         s.k1 = h1;
         s.k2 = h2;
-        s.prefix = prefix;
         s.len = (int32_t)wlen;
         s.idx = (uint32_t)n_unique;
-        words_len += wlen;
+        words_len += reclean(tok_start, to, words_out + words_len);
         ends_out[n_unique] = words_len;
         k1_out[n_unique] = h1;
         k2_out[n_unique] = h2;
@@ -243,7 +311,7 @@ int64_t mr_scan_count(const uint8_t* buf, int64_t len,
         ++n_unique;
         break;
       }
-      if (s.k1 == h1 && s.k2 == h2 && s.len == (int32_t)wlen && s.prefix == prefix) {
+      if (s.k1 == h1 && s.k2 == h2 && s.len == (int32_t)wlen) {
         ++counts_out[s.idx];
         break;
       }
@@ -255,54 +323,126 @@ int64_t mr_scan_count(const uint8_t* buf, int64_t len,
     return true;
   };
 
-  int64_t p = 0;
-  while (p < len) {
+  // One scalar byte/codepoint step; advances p. Returns false only on
+  // max_words overflow. Shared by the non-ASCII block path and the tail.
+  auto scalar_step = [&](int64_t& p) -> bool {
     uint8_t c = buf[p];
-    if (c < 0x80) {  // ASCII fast path — the kTables classes
+    if (c < 0x80) {  // ASCII — the kTables classes
       uint8_t cls = kTables.cls[c];
       if (cls == 1) {
-        words_out[words_len + wlen] = c;
+        if (!wlen) tok_start = p;
         ++wlen;
         h1 = h1 * H1_MULT + c + 1;
         h2 = h2 * H2_MULT + c + 1;
       } else if (cls == 2) {
-        if (!flush()) return -1;
+        if (!flush(p)) return false;
       }
       ++p;
-      continue;
+      return true;
     }
     // Non-ASCII: decode exactly like mr_normalize, classify via cpclass.
     uint32_t cp = 0;
     int n = 0;
-    if ((c & 0xE0) == 0xC0) { cp = c & 0x1F; n = 1; }
-    else if ((c & 0xF0) == 0xE0) { cp = c & 0x0F; n = 2; }
-    else if ((c & 0xF8) == 0xF0) { cp = c & 0x07; n = 3; }
-    else { ++p; continue; }  // invalid lead → U+FFFD → delete
-    bool ok = (p + n < len);
-    for (int j = 1; ok && j <= n; ++j) {
-      if ((buf[p + j] & 0xC0) != 0x80) ok = false;
-      else cp = (cp << 6) | (buf[p + j] & 0x3F);
-    }
-    if (!ok || cp > 0x10FFFF || (cp >= 0xD800 && cp <= 0xDFFF) ||
-        (n == 1 && cp < 0x80) || (n == 2 && cp < 0x800) || (n == 3 && cp < 0x10000)) {
-      ++p;
-      continue;
+    if (!decode_utf8(buf, len, p, &cp, &n)) {
+      ++p;  // invalid → U+FFFD → delete, resync at the next byte
+      return true;
     }
     uint8_t cls = cpclass[cp];
     if (cls == 1) {  // word codepoint: original bytes, hashed verbatim
+      if (!wlen) tok_start = p;
       for (int j = 0; j <= n; ++j) {
         uint8_t wc = buf[p + j];
-        words_out[words_len + wlen] = wc;
         ++wlen;
         h1 = h1 * H1_MULT + wc + 1;
         h2 = h2 * H2_MULT + wc + 1;
       }
     } else if (cls == 2) {
-      if (!flush()) return -1;
+      if (!flush(p)) return false;
     }
     p += n + 1;
+    return true;
+  };
+
+  int64_t p = 0;
+#ifdef __AVX2__
+  // SIMD fast path: classify a 64-byte all-ASCII block into word /
+  // whitespace / delete BIT MASKS with eight AVX2 ops, then walk only the
+  // set bits. Removes the per-byte class lookup and its mispredicted
+  // 3-way branch — the scalar loop's main cost — while producing exactly
+  // the same (word bytes, flush points) event stream: delete bits are
+  // simply absent from both masks, so punctuation still vanishes without
+  // splitting the token. Any block containing a non-ASCII byte falls back
+  // to the scalar stepper for its 64 bytes (UTF-8 may step past the block
+  // edge; the next SIMD load is unaligned-safe).
+  while (p + 64 <= len) {
+    __m256i lo = _mm256_loadu_si256((const __m256i*)(buf + p));
+    __m256i hi = _mm256_loadu_si256((const __m256i*)(buf + p + 32));
+    uint32_t na_lo = (uint32_t)_mm256_movemask_epi8(lo);
+    uint32_t na_hi = (uint32_t)_mm256_movemask_epi8(hi);
+    if (na_lo | na_hi) {  // non-ASCII somewhere in the block
+      int64_t stop = p + 64;
+      while (p < stop) {
+        if (!scalar_step(p)) return -1;
+      }
+      continue;
+    }
+    auto classify = [](__m256i v, uint32_t& w, uint32_t& s) {
+      __m256i lower = _mm256_or_si256(v, _mm256_set1_epi8(0x20));
+      __m256i alpha = _mm256_and_si256(
+          _mm256_cmpgt_epi8(lower, _mm256_set1_epi8('a' - 1)),
+          _mm256_cmpgt_epi8(_mm256_set1_epi8('z' + 1), lower));
+      __m256i digit = _mm256_and_si256(
+          _mm256_cmpgt_epi8(v, _mm256_set1_epi8('0' - 1)),
+          _mm256_cmpgt_epi8(_mm256_set1_epi8('9' + 1), v));
+      __m256i us = _mm256_cmpeq_epi8(v, _mm256_set1_epi8('_'));
+      w = (uint32_t)_mm256_movemask_epi8(
+          _mm256_or_si256(_mm256_or_si256(alpha, digit), us));
+      __m256i sp = _mm256_cmpeq_epi8(v, _mm256_set1_epi8(' '));
+      __m256i ctl = _mm256_and_si256(
+          _mm256_cmpgt_epi8(v, _mm256_set1_epi8(8)),
+          _mm256_cmpgt_epi8(_mm256_set1_epi8(14), v));  // \t\n\v\f\r
+      s = (uint32_t)_mm256_movemask_epi8(_mm256_or_si256(sp, ctl));
+    };
+    uint32_t w_lo, s_lo, w_hi, s_hi;
+    classify(lo, w_lo, s_lo);
+    classify(hi, w_hi, s_hi);
+    uint64_t m_word = ((uint64_t)w_hi << 32) | w_lo;
+    uint64_t m_ws = ((uint64_t)s_hi << 32) | s_lo;
+    while (m_ws) {
+      int nxt = __builtin_ctzll(m_ws);
+      uint64_t seg = nxt ? (m_word & ((1ULL << nxt) - 1)) : 0;
+      while (seg) {
+        int i = __builtin_ctzll(seg);
+        uint8_t c = buf[p + i];
+        if (!wlen) tok_start = p + i;
+        ++wlen;
+        h1 = h1 * H1_MULT + c + 1;
+        h2 = h2 * H2_MULT + c + 1;
+        seg &= seg - 1;
+      }
+      if (nxt < 63)  // consume bits <= nxt (seg bits hashed above)
+        m_word &= ~((1ULL << (nxt + 1)) - 1);
+      else
+        m_word = 0;
+      if (!flush(p + nxt)) return -1;
+      m_ws &= m_ws - 1;
+    }
+    while (m_word) {  // trailing word bytes after the last whitespace
+      int i = __builtin_ctzll(m_word);
+      uint8_t c = buf[p + i];
+      if (!wlen) tok_start = p + i;
+      ++wlen;
+      h1 = h1 * H1_MULT + c + 1;
+      h2 = h2 * H2_MULT + c + 1;
+      m_word &= m_word - 1;
+    }
+    p += 64;
   }
-  if (!flush()) return -1;
+#endif
+  while (p < len) {
+    if (!scalar_step(p)) return -1;
+  }
+  if (!flush(len)) return -1;
   return n_unique;
 }
 
@@ -329,22 +469,11 @@ int64_t mr_normalize(const uint8_t* buf, int64_t len,
       ++p;
       continue;
     }
-    // Decode one UTF-8 sequence (strict: range checks + continuations).
+    // Decode one UTF-8 sequence (the shared strict decoder); invalid →
+    // replace (delete) and resync at the next byte, like Python's decoder.
     uint32_t cp = 0;
     int n = 0;
-    if ((c & 0xE0) == 0xC0) { cp = c & 0x1F; n = 1; }
-    else if ((c & 0xF0) == 0xE0) { cp = c & 0x0F; n = 2; }
-    else if ((c & 0xF8) == 0xF0) { cp = c & 0x07; n = 3; }
-    else { ++p; continue; }  // stray continuation/invalid lead → U+FFFD → delete
-    bool ok = (p + n < len);  // truncated sequence at buffer end → invalid
-    for (int j = 1; ok && j <= n; ++j) {
-      if ((buf[p + j] & 0xC0) != 0x80) ok = false;
-      else cp = (cp << 6) | (buf[p + j] & 0x3F);
-    }
-    // Overlong / out-of-range / surrogate → invalid, like Python's strict
-    // decoder: replace (delete) and resync at the next byte.
-    if (!ok || cp > 0x10FFFF || (cp >= 0xD800 && cp <= 0xDFFF) ||
-        (n == 1 && cp < 0x80) || (n == 2 && cp < 0x800) || (n == 3 && cp < 0x10000)) {
+    if (!decode_utf8(buf, len, p, &cp, &n)) {
       ++p;  // consume just the lead byte (Python replaces per bad byte)
       continue;
     }

@@ -62,6 +62,15 @@ class Dictionary:
         # probe: a same-pair different-length word is caught without slicing.
         self._len_of: dict[int, int] = {}
         self.collisions: list[tuple[bytes, bytes]] = []  # (kept, rejected)
+        # Vectorized steady-state filter for add_scanned_raw: sorted packed
+        # keys + aligned stored lengths. Keys inserted since the last merge
+        # wait in _fresh_* (they just take the slow per-key path until
+        # merged), so membership for a saturated vocabulary is one
+        # searchsorted instead of 10^4-10^5 dict lookups per window.
+        self._packed_sorted = np.empty(0, dtype=np.uint64)
+        self._sorted_lens = np.empty(0, dtype=np.int64)
+        self._fresh_keys: list[int] = []
+        self._fresh_lens: list[int] = []
 
     def __len__(self) -> int:
         return len(self._word_of)
@@ -83,7 +92,13 @@ class Dictionary:
                 continue
             seen.add(w)
             key = (k1, k2)
-            self._len_of.setdefault((k1 << 32) | k2, len(w))
+            packed = (k1 << 32) | k2
+            if packed not in self._len_of:
+                self._len_of[packed] = len(w)
+                # Every insert path must feed the vectorized filter, or the
+                # key stays permanently "suspicious" to add_scanned_raw.
+                self._fresh_keys.append(packed)
+                self._fresh_lens.append(len(w))
             prev = word_of.get(key)
             if prev is None:
                 word_of[key] = w
@@ -101,33 +116,73 @@ class Dictionary:
         (recorded if different); an equal-length different-word pair
         collision passes undetected — covered by the same ~2^-64 birthday
         bound as the pair keying itself (SURVEY.md §7 hard part 3)."""
+        n = len(ends)
+        if n == 0:
+            return 0
         packed = (
             (keys[:, 0].astype(np.uint64) << np.uint64(32)) | keys[:, 1].astype(np.uint64)
-        ).tolist()
-        ends_l = ends.tolist()
-        len_of, word_of, seen = self._len_of, self._word_of, self._seen
+        )
+        wlens = np.diff(ends, prepend=np.int64(0))
+        # Steady-state fast path: a key already in the sorted table with a
+        # matching length needs no Python at all.
+        if len(self._packed_sorted):
+            idx = np.searchsorted(self._packed_sorted, packed)
+            idx_c = np.minimum(idx, len(self._packed_sorted) - 1)
+            known = (self._packed_sorted[idx_c] == packed) & (
+                self._sorted_lens[idx_c] == wlens
+            )
+        else:
+            known = np.zeros(n, dtype=bool)
+        suspicious = np.nonzero(~known)[0]
         added = 0
-        prev_end = 0
-        for i, p in enumerate(packed):
-            end = ends_l[i]
-            wlen = end - prev_end
-            stored = len_of.get(p)
-            if stored is None:
-                w = raw[prev_end:end]
-                len_of[p] = wlen
-                seen.add(w)
-                key = (int(keys[i, 0]), int(keys[i, 1]))
-                if key not in word_of:
-                    word_of[key] = w
-                    added += 1
-            elif stored != wlen:
-                w = raw[prev_end:end]
-                prev = word_of.get((int(keys[i, 0]), int(keys[i, 1])))
-                if prev is not None and prev != w and w not in seen:
+        if len(suspicious):
+            packed_l = packed.tolist()
+            ends_l = ends.tolist()
+            len_of, word_of, seen = self._len_of, self._word_of, self._seen
+            for i in suspicious.tolist():
+                end = ends_l[i]
+                prev_end = ends_l[i - 1] if i else 0
+                wlen = end - prev_end
+                p = packed_l[i]
+                stored = len_of.get(p)
+                if stored is None:
+                    w = raw[prev_end:end]
+                    len_of[p] = wlen
                     seen.add(w)
-                    self.collisions.append((prev, w))
-            prev_end = end
+                    key = (int(keys[i, 0]), int(keys[i, 1]))
+                    if key not in word_of:
+                        word_of[key] = w
+                        added += 1
+                    self._fresh_keys.append(p)
+                    self._fresh_lens.append(wlen)
+                elif stored != wlen:
+                    w = raw[prev_end:end]
+                    prev = word_of.get((int(keys[i, 0]), int(keys[i, 1])))
+                    if prev is not None and prev != w and w not in seen:
+                        seen.add(w)
+                        self.collisions.append((prev, w))
+            # Geometric threshold: rebuilding the sorted table costs O(V),
+            # so amortize it against a constant fraction of V — a fixed
+            # batch size would make maintenance O(V^2/batch) on
+            # high-cardinality corpora.
+            if len(self._fresh_keys) >= max(1024, len(self._packed_sorted) // 4):
+                self._merge_fresh()
         return added
+
+    def _merge_fresh(self) -> None:
+        if not self._fresh_keys:
+            return
+        pk = np.concatenate(
+            [self._packed_sorted, np.asarray(self._fresh_keys, dtype=np.uint64)]
+        )
+        ln = np.concatenate(
+            [self._sorted_lens, np.asarray(self._fresh_lens, dtype=np.int64)]
+        )
+        order = np.argsort(pk, kind="stable")
+        self._packed_sorted = pk[order]
+        self._sorted_lens = ln[order]
+        self._fresh_keys.clear()
+        self._fresh_lens.clear()
 
     def add_words(self, words: Iterable[bytes]) -> int:
         """Insert unseen words; returns the number of new entries.
@@ -168,7 +223,11 @@ class Dictionary:
             if prev is None:
                 self._word_of[key] = w
                 self._seen.add(w)
-                self._len_of.setdefault((key[0] << 32) | key[1], len(w))
+                packed = (key[0] << 32) | key[1]
+                if packed not in self._len_of:
+                    self._len_of[packed] = len(w)
+                    self._fresh_keys.append(packed)
+                    self._fresh_lens.append(len(w))
             elif prev != w:
                 self.collisions.append((prev, w))
 

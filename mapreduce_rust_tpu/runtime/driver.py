@@ -455,77 +455,50 @@ def _pack_update(keys: np.ndarray, values: np.ndarray, cap: int) -> np.ndarray:
     return flat
 
 
-def _iter_windows(cfg: Config, inputs, stats):
-    """(doc_id, raw window bytes) stream, cut at ASCII whitespace (safe
-    before normalization — normalize never alters ASCII bytes), read ahead
-    by one window on a prefetch thread. A token longer than the window is
-    force-cut at a UTF-8 sequence boundary and counted in
-    stats.forced_cuts — the same policy (and caveat) as the device
-    engine's chunker (runtime/chunker.py). Abandoning the generator stops
-    the producer and closes its file (no thread/fd leak)."""
-    import queue
-    import threading
+_CUT_PROBE = 1 << 16  # how far back a window cut searches for whitespace
 
+
+def _iter_windows(cfg: Config, inputs, stats):
+    """(doc_id, raw window view) stream — ZERO-COPY uint8 views over each
+    memory-mapped input, cut at ASCII whitespace (safe before
+    normalization — normalize never alters ASCII bytes). Only the last
+    _CUT_PROBE bytes of a window are materialized to find the cut; a
+    window whose final 64 KB contains no whitespace is force-cut at a
+    UTF-8 sequence boundary and counted in stats.forced_cuts (the device
+    chunker's policy; note its force threshold is a whole chunk, but any
+    token past _CUT_PROBE already exceeds the tokenizer's max_word_len by
+    three orders of magnitude). No read-ahead thread: the page-faulting
+    sequential read happens inside the GIL-free native scan, which the
+    engine already overlaps with the Python glue."""
     from mapreduce_rust_tpu.runtime.chunker import _ws_cut, utf8_safe_cut
 
-    q: "queue.Queue" = queue.Queue(maxsize=2)
-    stop = threading.Event()
+    for doc_id, path in enumerate(inputs):
+        size = os.path.getsize(path)
+        stats.bytes_in += size
+        if size == 0:
+            continue
+        mm = np.memmap(path, dtype=np.uint8, mode="r")
+        try:  # sequential readahead: fault whole extents, not page by page
+            import mmap as _mmap
 
-    def put(item) -> bool:
-        while not stop.is_set():
-            try:
-                q.put(item, timeout=0.2)
-                return True
-            except queue.Full:
-                continue
-        return False
-
-    def produce() -> None:
-        try:
-            for doc_id, path in enumerate(inputs):
-                stats.bytes_in += os.path.getsize(path)
-                carry = b""
-                with open(path, "rb") as f:
-                    while True:
-                        block = f.read(cfg.host_window_bytes)
-                        if not block:
-                            if carry and not put((doc_id, carry)):
-                                return
-                            break
-                        buf = carry + block
-                        cut, forced = _ws_cut(buf, 0, len(buf))
-                        if forced:
-                            # One giant token: force-cut, never inside a
-                            # UTF-8 sequence (shared chunker policy).
-                            stats.forced_cuts += 1
-                            cut = utf8_safe_cut(buf, cut)
-                        carry = buf[cut:]
-                        if not put((doc_id, buf[:cut])):
-                            return
-            put(_SENTINEL)
-        except BaseException as e:
-            put(e)
-
-    thread = threading.Thread(target=produce, daemon=True)
-    thread.start()
-    try:
-        while True:
-            t0 = time.perf_counter()
-            item = q.get()
-            stats.ingest_wait_s += time.perf_counter() - t0
-            if item is _SENTINEL:
-                return
-            if isinstance(item, BaseException):
-                raise item
-            yield item
-    finally:
-        stop.set()
-        try:
-            while True:
-                q.get_nowait()
-        except queue.Empty:
+            mm._mmap.madvise(_mmap.MADV_SEQUENTIAL)
+        except (AttributeError, OSError, ValueError):
             pass
-        thread.join(timeout=5)
+        start = 0
+        while start < size:
+            end = min(start + cfg.host_window_bytes, size)
+            if end < size:
+                probe_at = max(start, end - _CUT_PROBE)
+                tail = mm[probe_at:end].tobytes()
+                off, forced = _ws_cut(tail, 0, len(tail))
+                if forced:
+                    stats.forced_cuts += 1
+                    off = utf8_safe_cut(tail, off)
+                cut = probe_at + off
+            else:
+                cut = end
+            yield doc_id, mm[start:cut]
+            start = cut
 
 
 def _py_scan_count(window: bytes):
@@ -536,7 +509,7 @@ def _py_scan_count(window: bytes):
     from mapreduce_rust_tpu.core.normalize import normalize_unicode
     from mapreduce_rust_tpu.runtime.dictionary import extract_words
 
-    counter = collections.Counter(extract_words(normalize_unicode(window)))
+    counter = collections.Counter(extract_words(normalize_unicode(bytes(window))))
     words = list(counter.keys())
     keys = hash_words(words)
     counts = np.asarray([counter[w] for w in words], dtype=np.uint32)
@@ -577,14 +550,26 @@ def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
                 stats.spilled_keys += int(ev_n)
                 acc.add_batch(evicted)
 
-    for doc_id, window in _iter_windows(cfg, inputs, stats):
-        stats.chunks += 1
+    def scan_window(item):
+        doc_id, window = item
+        t0 = time.perf_counter()
         res = scan_count_raw(window)
         if res is not None:
+            stats.host_map_s += time.perf_counter() - t0
+            return doc_id, "raw", res
+        out = doc_id, "py", _py_scan_count(window)
+        stats.host_map_s += time.perf_counter() - t0
+        return out
+
+    def consume(result) -> None:
+        nonlocal state
+        doc_id, kind, res = result
+        stats.chunks += 1
+        if kind == "raw":
             raw, ends, keys, counts = res
             dictionary.add_scanned_raw(raw, ends, keys)
         else:
-            words, keys, counts = _py_scan_count(window)
+            words, keys, counts = res
             dictionary.add_scanned(words, keys)
         values = app.host_values(counts, doc_id_offset + doc_id)
         # Fixed update capacity, splitting big windows across merges: ONE
@@ -601,6 +586,25 @@ def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
             pending.append((ev_count, evicted))
         if len(pending) >= 2 * depth:
             drain(depth)
+
+    # The C scan releases the GIL, so scanning window k+1 on a worker
+    # thread overlaps the Python-side dictionary/pack/dispatch glue of
+    # window k. One worker: scans are the serial backbone and the
+    # per-thread scratch (native/host._buffers) then reuses one arena.
+    from concurrent.futures import ThreadPoolExecutor
+
+    pool = ThreadPoolExecutor(max_workers=1)
+    prev = None
+    try:
+        for item in _iter_windows(cfg, inputs, stats):
+            fut = pool.submit(scan_window, item)
+            if prev is not None:
+                consume(prev.result())
+            prev = fut
+        if prev is not None:
+            consume(prev.result())
+    finally:
+        pool.shutdown(wait=False)
     drain(len(pending))
     acc.add_batch(state)
 

@@ -37,6 +37,7 @@ class JobStats:
     # blocked on device results. ingest_wait ≫ device_wait → host-bound.
     ingest_wait_s: float = 0.0
     device_wait_s: float = 0.0
+    host_map_s: float = 0.0       # CPU time in the host-map engine's scan
 
     @property
     def gb_per_s(self) -> float:
@@ -44,9 +45,13 @@ class JobStats:
 
     @property
     def bottleneck(self) -> str:
-        if not (self.ingest_wait_s or self.device_wait_s):
-            return "balanced"
-        return "host-ingest" if self.ingest_wait_s >= self.device_wait_s else "device"
+        parts = {
+            "host-ingest": self.ingest_wait_s,
+            "device": self.device_wait_s,
+            "host-map": self.host_map_s,
+        }
+        name, val = max(parts.items(), key=lambda kv: kv[1])
+        return name if val > 0 else "balanced"
 
     @contextmanager
     def phase(self, name: str):
