@@ -52,6 +52,9 @@ def _cfg(args, map_n: int = 1, worker_n: int = 1) -> Config:
         chunk_bytes=int(args.chunk_mb * (1 << 20)),
         device=args.device,
         map_engine=getattr(args, "map_engine", "device"),
+        sharded_stream=getattr(args, "sharded", False),
+        checkpoint_every_groups=getattr(args, "checkpoint_every", 0),
+        resume=getattr(args, "resume", False),
         mesh_shape=getattr(args, "mesh", None),
         profile_dir=args.profile_dir,
         host=args.host,
@@ -68,6 +71,12 @@ def _app(args):
 
 
 def cmd_run(args) -> int:
+    if getattr(args, "distributed", False):
+        # Before ANY jax call: backend creation binds the process's client.
+        from mapreduce_rust_tpu.parallel.distributed import initialize
+
+        initialize(args.coordinator, args.num_processes, args.process_id)
+
     from mapreduce_rust_tpu.runtime.driver import run_job
     from mapreduce_rust_tpu.runtime.chunker import list_inputs
 
@@ -149,6 +158,25 @@ def main(argv: list[str] | None = None) -> int:
                    help="device: tokenize/combine fully on-chip; host: fused "
                    "native scan maps on the host, device merges (fastest when "
                    "host->device bandwidth is the bottleneck)")
+    p.add_argument("--sharded", action="store_true", dest="sharded",
+                   help="with --mesh: sequence-parallel ingestion — the byte "
+                   "stream is cut at arbitrary offsets across chips and a "
+                   "halo exchange reconstructs straddling tokens")
+    p.add_argument("--checkpoint-every", type=int, default=0,
+                   dest="checkpoint_every",
+                   help="with --mesh: write an atomic data-plane checkpoint "
+                   "every N groups (work dir driver.ckpt.*)")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the work dir's driver checkpoint when "
+                   "it matches this job's fingerprint")
+    p.add_argument("--distributed", action="store_true",
+                   help="join a multi-host jax.distributed cluster before "
+                   "building the mesh; the all_to_all shuffle then rides "
+                   "ICI intra-slice and DCN across hosts")
+    p.add_argument("--coordinator", default="127.0.0.1:12321",
+                   help="--distributed: coordinator address host:port")
+    p.add_argument("--num-processes", type=int, default=1, dest="num_processes")
+    p.add_argument("--process-id", type=int, default=0, dest="process_id")
 
     p = sub.add_parser("coordinator", help="control-plane scheduler")
     _add_common(p)

@@ -48,6 +48,14 @@ class App:
         """On-device per-chunk transform; doc_id is a traced int32 scalar."""
         return kv
 
+    @property
+    def device_select_k(self) -> "int | None":
+        """Non-None → the mesh driver may finish the job by fetching only
+        the per-chip top-k candidates instead of the whole state
+        (parallel/topk.py). Only sound for apps whose final answer is a
+        global top-k over scalar values."""
+        return None
+
     def host_values(self, counts, doc_id: int):
         """Host-map-engine counterpart of device_map: values for one
         window's unique keys, given their occurrence counts (uint32[n]).

@@ -28,6 +28,12 @@ class TopK(App):
     combine_op: str = "sum"
     k: int = 20
 
+    @property
+    def device_select_k(self) -> int:
+        """Mesh runs pull only per-chip top-k candidates over ICI
+        (parallel/topk.py) instead of the whole sharded state."""
+        return self.k
+
     def finalize(
         self, items: Iterable[tuple[bytes, int, tuple[int, int]]], reduce_n: int
     ) -> dict[int, list[bytes]]:

@@ -32,6 +32,14 @@ class Config:
                                     # exact — see effective_partial_capacity)
     bucket_capacity_factor: float = 2.0  # all_to_all per-bucket slack
     device: str = "auto"            # "auto" | "tpu" | "cpu"
+    sharded_stream: bool = False    # mesh mode only: feed each window as ONE
+                                    # contiguous device-resident stream cut at
+                                    # arbitrary (mid-word) offsets across the
+                                    # chips; a halo exchange (parallel/halo.py)
+                                    # makes straddling tokens count exactly
+                                    # once. The long-context/sequence-parallel
+                                    # ingestion path (SURVEY.md §5) vs the
+                                    # host-aligned chunker.
     map_engine: str = "device"      # "device": tokenize/hash/combine fully
                                     # on-chip (the TPU-native kernels;
                                     # best when the chip link is wide).
@@ -74,6 +82,17 @@ class Config:
                                     # of seconds; without this every process
                                     # (bench, each worker, the dryrun) pays
                                     # them again.
+
+    # ---- Data-plane checkpointing (single-process mesh driver) ----
+    checkpoint_every_groups: int = 0  # >0: after every N mesh groups, drain
+                                    # the pipeline and write an atomic
+                                    # work_dir/driver.ckpt (device state +
+                                    # spill accumulator + dictionary +
+                                    # progress). The single-process analog
+                                    # of the control plane's spill-file +
+                                    # journal story (coordinator/server.py).
+    resume: bool = False            # start from work_dir/driver.ckpt when it
+                                    # matches this job's fingerprint
 
     # ---- Control plane (reference timings preserved) ----
     host: str = "127.0.0.1"

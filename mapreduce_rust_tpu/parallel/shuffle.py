@@ -90,6 +90,57 @@ def make_shuffle_step_fns(app: App, u_cap: int, bucket_cap: int, mesh: Mesh):
     return fns
 
 
+_KV_SHUFFLE_FNS: dict = {}  # (app, u_cap, bucket_cap, mesh, width) → fn
+
+
+def make_kv_shuffle_step_fns(app: App, u_cap: int, bucket_cap: int, mesh: Mesh):
+    """map_shuffle over PRE-TOKENIZED records: KVBatch [D, W] (one row of
+    tokens per chip, e.g. parallel/halo.make_sharded_tokenizer output) →
+    (local KVBatch [D, D*bucket_cap], partial_ovf [D], bucket_ovf [D]).
+    The combine → bucket scatter → all_to_all → combine tail is identical
+    to make_shuffle_step_fns; only the tokenizer is elsewhere. Pair with
+    make_shuffle_step_fns(...)[1] for the merge."""
+    key = (app, u_cap, bucket_cap, mesh)
+    fn = _KV_SHUFFLE_FNS.get(key)
+    if fn is None:
+        fn = _KV_SHUFFLE_FNS[key] = _build_kv_shuffle(app, u_cap, bucket_cap, mesh)
+    return fn
+
+
+def _build_kv_shuffle(app: App, u_cap: int, bucket_cap: int, mesh: Mesh):
+    op = app.combine_op
+    d = mesh.devices.size
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(AXIS, None), P(AXIS)),
+        out_specs=(P(AXIS), P(AXIS), P(AXIS)),
+    )
+    def map_shuffle_kv(kv: KVBatch, doc_ids: jnp.ndarray):
+        mine = KVBatch(*(x[0] for x in kv))
+        mine = app.device_map(mine, doc_ids[0])
+        partial = count_unique(mine, op=op)
+        update = partial.take_front(u_cap)
+        p_ovf = jnp.sum(partial.valid[u_cap:].astype(jnp.int32))
+        buckets, b_ovf = bucket_scatter(update, num_buckets=d, capacity=bucket_cap)
+        recv = jax.tree.map(
+            lambda x: jax.lax.all_to_all(x, AXIS, split_axis=0, concat_axis=0, tiled=True),
+            buckets,
+        )
+        flat = KVBatch(*(x.reshape(-1) for x in recv))
+        local = count_unique(flat, op=op)
+        bad = jax.lax.psum(p_ovf + b_ovf, AXIS) > 0
+        local = local._replace(valid=local.valid & ~bad)
+        return (
+            KVBatch(*(x[None] for x in local)),
+            p_ovf[None],
+            b_ovf[None],
+        )
+
+    return map_shuffle_kv
+
+
 def _build_shuffle_step_fns(app: App, u_cap: int, bucket_cap: int, mesh: Mesh):
     """(map_shuffle, merge) — the group-of-D-chunks mesh pipeline.
 

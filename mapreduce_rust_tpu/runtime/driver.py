@@ -48,6 +48,7 @@ import functools
 import os
 import time
 from typing import Sequence
+from zipfile import BadZipFile
 
 import jax
 import jax.numpy as jnp
@@ -175,6 +176,13 @@ class HostAccumulator:
         keys, vals = batch.to_host()
         self.add(keys, vals)
 
+    def snapshot(self) -> tuple[np.ndarray, np.ndarray]:
+        """(keys [n,2], vals [n]) of everything accumulated so far — for
+        the driver checkpoint. Only valid before .table is first read."""
+        if not self._keys:
+            return np.empty((0, 2), np.int64), np.empty(0, np.int64)
+        return np.concatenate(self._keys), np.concatenate(self._vals)
+
     @property
     def table(self) -> dict:
         if self._table is None:
@@ -242,13 +250,18 @@ class _IngestStream:
     task passes its task id so inverted_index doc ids stay global)."""
 
     def __init__(self, cfg: Config, inputs: Sequence[str], stats: JobStats,
-                 dictionary: Dictionary, doc_id_offset: int = 0) -> None:
+                 dictionary: Dictionary, doc_id_offset: int = 0,
+                 skip_chunks: int = 0) -> None:
         import queue
         import threading
         from concurrent.futures import ThreadPoolExecutor
 
         self.cfg = cfg
         self.stats = stats
+        # Chunks below a resumed checkpoint: read (the chunker must stay
+        # positionally deterministic) but neither dictionary-scanned nor
+        # yielded — their words and counts are already in the checkpoint.
+        self.skip_chunks = skip_chunks
         self.dictionary = dictionary
         self.workers = max(cfg.ingest_threads, 1)
         self.pool = ThreadPoolExecutor(max_workers=self.workers)
@@ -305,6 +318,9 @@ class _IngestStream:
                 if self.err is not None:
                     raise self.err
                 return
+            if self.skip_chunks > 0:
+                self.skip_chunks -= 1
+                continue
             self.scans.append(
                 self.pool.submit(_scan_payload, bytes(chunk.data[: chunk.nbytes]))
             )
@@ -609,6 +625,239 @@ def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
     acc.add_batch(state)
 
 
+def _ckpt_paths(cfg: Config) -> tuple[str, str]:
+    return (
+        os.path.join(cfg.work_dir, "driver.ckpt.npz"),
+        os.path.join(cfg.work_dir, "driver.ckpt.dict"),
+    )
+
+
+def _job_fingerprint(cfg: Config, app: App, inputs, d: int) -> str:
+    """Ties a checkpoint to (inputs, app, every shape-determining knob): a
+    mismatch on resume is silently ignored, never trusted."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for p in inputs:
+        st = os.stat(p)
+        h.update(f"{p}:{st.st_size}:{st.st_mtime_ns};".encode())
+    h.update(
+        f"{app.name}:{app.combine_op}:{cfg.chunk_bytes}:{d}:"
+        f"{cfg.effective_partial_capacity()}:{cfg.merge_capacity}".encode()
+    )
+    return h.hexdigest()
+
+
+def _write_ckpt(cfg: Config, fingerprint: str, state: KVBatch, groups_done: int,
+                acc, dictionary, stats) -> None:
+    """Atomic driver checkpoint: device state + host spill accumulator +
+    progress in one npz (the commit point), dictionary beside it. The
+    dictionary file renames FIRST: its content only ever grows, so a
+    newer-than-npz dictionary is a superset — safe — while the npz commit
+    guarantees a complete dictionary exists. This is the single-process
+    mesh driver's equivalent of the control plane's spill-file checkpoints
+    + fingerprinted journal (coordinator/server.py, worker/runtime.py)."""
+    npz_path, dict_path = _ckpt_paths(cfg)
+    os.makedirs(cfg.work_dir, exist_ok=True)
+    tmp_d = dict_path + f".{os.getpid()}.tmp"
+    dictionary.save(tmp_d)
+    os.replace(tmp_d, dict_path)
+    k1, k2, value, valid = (np.asarray(x) for x in jax.device_get(tuple(state)))
+    acc_keys, acc_vals = acc.snapshot()
+    tmp_n = npz_path + f".{os.getpid()}.tmp"
+    with open(tmp_n, "wb") as f:
+        np.savez(
+            f,
+            fingerprint=np.frombuffer(fingerprint.encode(), dtype=np.uint8),
+            k1=k1, k2=k2, value=value, valid=valid,
+            groups_done=np.int64(groups_done),
+            acc_keys=acc_keys, acc_vals=acc_vals,
+            spill_events=np.int64(stats.spill_events),
+            spilled_keys=np.int64(stats.spilled_keys),
+        )
+    os.replace(tmp_n, npz_path)
+    log.info("checkpoint: %d groups done", groups_done)
+
+
+def _load_ckpt(cfg: Config, fingerprint: str):
+    """(state_arrays, groups_done, acc_keys, acc_vals, spill_events,
+    spilled_keys, dict_path) or None (absent / torn / different job)."""
+    npz_path, dict_path = _ckpt_paths(cfg)
+    if not (os.path.exists(npz_path) and os.path.exists(dict_path)):
+        return None
+    try:
+        with np.load(npz_path) as z:
+            if bytes(z["fingerprint"]).decode() != fingerprint:
+                log.warning("checkpoint fingerprint mismatch — starting fresh")
+                return None
+            return (
+                KVBatch(z["k1"], z["k2"], z["value"], z["valid"]),
+                int(z["groups_done"]),
+                z["acc_keys"], z["acc_vals"],
+                int(z["spill_events"]), int(z["spilled_keys"]),
+                dict_path,
+            )
+    except (OSError, ValueError, KeyError, BadZipFile) as e:
+        log.warning("unreadable checkpoint (%s) — starting fresh", e)
+        return None
+
+
+def _finish_mesh_state(app: App, mesh, state, stats, acc) -> None:
+    """Fold the final sharded state into the host accumulator. Top-k apps
+    fetch only per-chip candidates over ICI (parallel/topk.py) when that
+    is provably exact: no spills (a spilled key's device value is partial)
+    and no value tie at any chip's k boundary (the word tie-break needs
+    bytes the device doesn't have)."""
+    k = app.device_select_k
+    if k and stats.spill_events == 0:
+        from mapreduce_rust_tpu.parallel.topk import topk_candidates
+
+        res = topk_candidates(mesh, state, k)
+        if res is not None:
+            keys, vals = res
+            acc.add(keys, vals)
+            log.info("device top-%d selection: %d candidates fetched", k, len(vals))
+            return
+        log.info("device top-%d selection ambiguous (value tie at boundary) "
+                 "— falling back to full state fetch", k)
+    acc.add_batch(state)
+
+
+def _stream_sharded(cfg: Config, app: App, inputs, stats, acc, dictionary) -> None:
+    """Sequence-parallel mesh ingestion: each normalized window rides the
+    mesh as ONE contiguous byte stream cut at arbitrary — mid-word, even
+    mid-UTF-8-sequence — equal offsets, one shard per chip. A ppermute
+    halo exchange (parallel/halo.py) hashes straddling tokens exactly once
+    (owned by the chip where the token ENDS), then the records take the
+    standard combine → bucket scatter → all_to_all → merge pipeline. This
+    is SURVEY.md §5's long-context row made end-to-end: the reference's
+    sequence ceiling is one whole file in one String per task
+    (src/mr/worker.rs:65-77); here no chip ever needs a token-aligned —
+    or even character-aligned — view of the stream.
+
+    Tokens longer than the halo (cfg.max_word_len) may hash truncated;
+    they are DETECTED on device and counted in stats.halo_truncations,
+    this framework's standard posture for capacity faults."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from mapreduce_rust_tpu.core.normalize import normalize_unicode
+    from mapreduce_rust_tpu.native.host import normalize_native
+    from mapreduce_rust_tpu.parallel.halo import make_sharded_tokenizer, shard_stream
+    from mapreduce_rust_tpu.parallel.shuffle import (
+        AXIS,
+        default_bucket_cap,
+        make_kv_shuffle_step_fns,
+        make_mesh,
+        make_shuffle_step_fns,
+        sharded_empty_state,
+    )
+
+    if cfg.checkpoint_every_groups or cfg.resume:
+        raise ValueError(
+            "checkpoint/resume is not supported in sharded-stream mode "
+            "(use the chunked mesh path, or run without sharded_stream)"
+        )
+    enable_compilation_cache(cfg.compilation_cache_dir)
+    backend = None if cfg.device == "auto" else cfg.device
+    mesh = make_mesh(cfg.mesh_shape, backend)
+    d = mesh.devices.size
+    u_cap = cfg.effective_partial_capacity()
+    bucket_cap = default_bucket_cap(u_cap, d, cfg.bucket_capacity_factor)
+    tokenize = make_sharded_tokenizer(mesh, halo=cfg.max_word_len)
+    kv_shuffle = make_kv_shuffle_step_fns(app, u_cap, bucket_cap, mesh)
+    merge = make_shuffle_step_fns(app, u_cap, bucket_cap, mesh)[1]
+    wide: dict = {}  # lazily-compiled full-width replay tier
+
+    state = sharded_empty_state(mesh, max(cfg.merge_capacity // d, 16))
+    in_shard = NamedSharding(mesh, P(AXIS))
+    rep = NamedSharding(mesh, P(AXIS))
+    depth = max(max(cfg.pipeline_depth, 1) // d, 4)
+    pending: collections.deque = collections.deque()
+    shard_bytes = max(cfg.chunk_bytes, 2 * cfg.max_word_len + 8)
+
+    def replay_group(group_bytes: bytes, doc_id: int, p_n: int) -> None:
+        # The fast path clamped the whole group to empty on device, so
+        # re-run it through the full-width tier (u_cap = the whole token
+        # window, bucket_cap = u_cap — overflow structurally impossible)
+        # and merge that. Exact, never silent, like every capacity fault.
+        nonlocal state
+        stats.partial_overflow_replays += int(p_n > 0)
+        stats.bucket_skew_replays += int(p_n == 0)
+        if not wide:
+            w_cap = cfg.max_word_len + shard_bytes + 1  # the full window
+            wide["fns"] = make_kv_shuffle_step_fns(app, w_cap, w_cap, mesh)
+            wide["merge"] = make_shuffle_step_fns(app, w_cap, w_cap, mesh)[1]
+        shards = jax.device_put(shard_stream(group_bytes, mesh, pad=shard_bytes), in_shard)
+        docs = jax.device_put(np.full(d, doc_id, dtype=np.int32), rep)
+        kv, _trunc = tokenize(shards)
+        local, _p, _b = wide["fns"](kv, docs)
+        state, evicted, ev_counts = wide["merge"](state, local)
+        ev_n = int(np.asarray(jax.device_get(ev_counts)).sum())
+        if ev_n > 0:
+            stats.spill_events += 1
+            stats.spilled_keys += ev_n
+            acc.add_batch(evicted)
+
+    def drain(n: int) -> None:
+        if n <= 0:
+            return
+        batch = [pending.popleft() for _ in range(n)]
+        t0 = time.perf_counter()
+        flat = jax.device_get([x for row in batch for x in row[:4]])
+        stats.device_wait_s += time.perf_counter() - t0
+        for row, trunc, p_ovf, b_ovf, ev in zip(
+            batch, flat[::4], flat[1::4], flat[2::4], flat[3::4]
+        ):
+            stats.halo_truncations += int(np.asarray(trunc).sum())
+            ev_n = int(np.asarray(ev).sum())
+            if ev_n > 0:
+                stats.spill_events += 1
+                stats.spilled_keys += ev_n
+                acc.add_batch(row[4])
+            p_n = int(np.asarray(p_ovf).sum())
+            b_n = int(np.asarray(b_ovf).sum())
+            if p_n or b_n:
+                replay_group(row[5], row[6], p_n)
+
+    for doc_id, window in _iter_windows(cfg, inputs, stats):
+        stats.chunks += 1
+        raw = bytes(window)
+        norm = normalize_native(raw)
+        if norm is None:
+            norm = normalize_unicode(raw)
+        dictionary.add_text(norm)
+        # Group seams are host-side cuts like window seams, so they align
+        # to whitespace — a token split THERE would fragment into keys no
+        # dictionary entry matches. The arbitrary (mid-word) cuts this
+        # mode demonstrates are the D-1 chip seams inside each group,
+        # which the halo exchange repairs on device.
+        from mapreduce_rust_tpu.runtime.chunker import _ws_cut
+
+        off = 0
+        while off < len(norm):
+            end = min(off + d * shard_bytes, len(norm))
+            if end < len(norm):
+                probe = norm[max(off, end - cfg.max_word_len - 1) : end]
+                o, forced = _ws_cut(probe, 0, len(probe))
+                if forced:
+                    stats.forced_cuts += 1
+                else:
+                    end -= len(probe) - o
+            group = norm[off:end]
+            off = end
+            shards = jax.device_put(shard_stream(group, mesh, pad=shard_bytes), in_shard)
+            docs = jax.device_put(
+                np.full(d, doc_id, dtype=np.int32), rep
+            )
+            kv, trunc = tokenize(shards)
+            local, p_ovf, b_ovf = kv_shuffle(kv, docs)
+            state, evicted, ev_counts = merge(state, local)
+            pending.append((trunc, p_ovf, b_ovf, ev_counts, evicted, group, doc_id))
+            if len(pending) >= 2 * depth:
+                drain(depth)
+    drain(len(pending))
+    _finish_mesh_state(app, mesh, state, stats, acc)
+
+
 def _stream_mesh(cfg: Config, app: App, inputs, stats, acc, dictionary) -> None:
     """Group-of-D-chunks pipeline over the 1-D mesh (parallel/shuffle.py)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -636,6 +885,20 @@ def _stream_mesh(cfg: Config, app: App, inputs, stats, acc, dictionary) -> None:
     # same O(depth × chunk_bytes) the single-chip path pays.
     depth = max(max(cfg.pipeline_depth, 1) // d, 4)
     pending: collections.deque = collections.deque()
+
+    fingerprint = _job_fingerprint(cfg, app, inputs, d)
+    groups_done = 0
+    skip_chunks = 0
+    if cfg.resume:
+        ck = _load_ckpt(cfg, fingerprint)
+        if ck is not None:
+            st_host, groups_done, ak, av, sev, skk, dict_path = ck
+            state = jax.device_put(st_host, NamedSharding(mesh, P(AXIS, None)))
+            skip_chunks = groups_done * d
+            acc.add(ak, av)
+            dictionary.merge(Dictionary.load(dict_path))
+            stats.spill_events, stats.spilled_keys = sev, skk
+            log.info("resumed from checkpoint: %d groups already merged", groups_done)
 
     def replay_group(chunks_host, docs_host, p_ovf_n: int) -> None:
         # The fast path clamped this whole group to empty on device
@@ -692,7 +955,7 @@ def _stream_mesh(cfg: Config, app: App, inputs, stats, acc, dictionary) -> None:
     group_docs: list[int] = []
 
     def submit_group() -> None:
-        nonlocal state
+        nonlocal state, groups_done
         while len(group_chunks) < d:  # pad the tail group with space chunks
             group_chunks.append(np.full(cfg.chunk_bytes, 0x20, dtype=np.uint8))
             group_docs.append(0)
@@ -708,10 +971,17 @@ def _stream_mesh(cfg: Config, app: App, inputs, stats, acc, dictionary) -> None:
         # arrays are kept for the rare replay instead of device buffers.
         state, evicted, ev_counts = fast[1](state, local)
         pending.append((p_ovf, b_ovf, ev_counts, evicted, chunks_host, docs_host))
-        if len(pending) >= 2 * depth:
+        groups_done += 1
+        if (
+            cfg.checkpoint_every_groups > 0
+            and groups_done % cfg.checkpoint_every_groups == 0
+        ):
+            drain(len(pending))  # state must reflect every submitted group
+            _write_ckpt(cfg, fingerprint, state, groups_done, acc, dictionary, stats)
+        elif len(pending) >= 2 * depth:
             drain(depth)
 
-    ingest = _IngestStream(cfg, inputs, stats, dictionary)
+    ingest = _IngestStream(cfg, inputs, stats, dictionary, skip_chunks=skip_chunks)
     try:
         for chunk in ingest:
             group_chunks.append(chunk.data)
@@ -725,7 +995,7 @@ def _stream_mesh(cfg: Config, app: App, inputs, stats, acc, dictionary) -> None:
         ingest.close(abort=True)
         raise
     ingest.close()
-    acc.add_batch(state)
+    _finish_mesh_state(app, mesh, state, stats, acc)
 
 
 def run_job(
@@ -753,7 +1023,9 @@ def run_job(
         else contextlib.nullcontext()
     )
     with stats.phase("stream"), prof:
-        if cfg.mesh_shape and cfg.mesh_shape > 1:
+        if cfg.mesh_shape and cfg.mesh_shape > 1 and cfg.sharded_stream:
+            _stream_sharded(cfg, app, inputs, stats, acc, dictionary)
+        elif cfg.mesh_shape and cfg.mesh_shape > 1:
             _stream_mesh(cfg, app, inputs, stats, acc, dictionary)
         elif cfg.map_engine == "host":
             _stream_host_map(cfg, app, inputs, stats, acc, dictionary)

@@ -27,6 +27,8 @@ class JobStats:
     spilled_keys: int = 0         # records moved device → host accumulator
     partial_overflow_replays: int = 0  # chunks re-run on the full-width path
     bucket_skew_replays: int = 0       # mesh groups re-run on the skew tier
+    halo_truncations: int = 0     # sharded-stream tokens longer than the halo
+                                  # (possibly truncated hash — exactness fault)
     dictionary_words: int = 0
     hash_collisions: int = 0
     unknown_keys: int = 0         # final keys missing from the dictionary

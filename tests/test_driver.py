@@ -213,3 +213,168 @@ def test_host_engine_python_fallback(tmp_path, monkeypatch):
     paths = write_inputs(tmp_path, texts)
     res = run_job(host_cfg(tmp_path), paths, write_outputs=False)
     assert res.table == oracle_counts(texts)
+
+
+# ---- sharded-stream (halo) ingestion end-to-end ----
+
+
+@pytest.mark.parametrize("mesh_d", [2, 4, 8])
+def test_sharded_stream_matches_oracle(tmp_path, mesh_d):
+    # Continuous text with no newlines near shard boundaries: equal-offset
+    # cuts are guaranteed to land inside words; the halo must fix them.
+    text = ("interdependence " * 500 + "zebra quagga ") * 3
+    paths = write_inputs(tmp_path, [text])
+    cfg = small_cfg(tmp_path, mesh_shape=mesh_d, sharded_stream=True,
+                    chunk_bytes=2048)
+    res = run_job(cfg, paths, write_outputs=False)
+    assert res.table == oracle_counts([text])
+    assert res.stats.halo_truncations == 0
+
+
+def test_sharded_stream_multi_doc_inverted_index(tmp_path):
+    texts = ["alpha beta gamma " * 40, "beta delta " * 60]
+    paths = write_inputs(tmp_path, texts)
+    cfg = small_cfg(tmp_path, mesh_shape=4, sharded_stream=True, chunk_bytes=512)
+    res = run_job(cfg, paths, app=InvertedIndex(), write_outputs=False)
+    oracle = {}
+    for d, t in enumerate(texts):
+        for w in set(t.split()):
+            oracle.setdefault(w.encode(), set()).add(d)
+    assert res.table == {w: sorted(s) for w, s in oracle.items()}
+
+
+def test_sharded_stream_detects_halo_truncation(tmp_path):
+    # One token longer than the halo (max_word_len) that straddles a shard
+    # boundary MUST be detected, never silently miscounted.
+    long_tok = "x" * 300
+    text = ("pad " * 200) + long_tok + (" tail" * 200)
+    paths = write_inputs(tmp_path, [text])
+    cfg = small_cfg(tmp_path, mesh_shape=4, sharded_stream=True,
+                    chunk_bytes=512, max_word_len=64)
+    res = run_job(cfg, paths, write_outputs=False)
+    assert res.stats.halo_truncations > 0
+
+
+# ---- device-side top-k selection (parallel/topk.py) ----
+
+
+def test_mesh_top_k_device_selection_matches_oracle(tmp_path):
+    # Distinct counts per word → no boundary ties → the device-candidate
+    # path runs; per-chip candidates (k=3) << vocabulary (100 words).
+    words = [f"w{i:03d}" for i in range(100)]
+    text = " ".join(w for i, w in enumerate(words) for _ in range(i + 1))
+    paths = write_inputs(tmp_path, [text])
+    cfg = small_cfg(tmp_path, mesh_shape=4, reduce_n=2)
+    res = run_job(cfg, paths, app=TopK(k=3))
+    # Device selection fetched only per-chip candidates (<= 4*3), not the
+    # 100-word vocabulary...
+    assert len(res.table) <= 12
+    # ...and the selected output is still the exact global top 3.
+    lines = open(res.output_files[0], "rb").read().splitlines()
+    assert lines == [b"w099 100", b"w098 99", b"w097 98"]
+
+
+def test_mesh_top_k_tie_fallback_exact(tmp_path):
+    # Every word has count 3 → every chip's k boundary is value-tied → the
+    # device path must fall back to the full fetch and match the host
+    # (bytewise word) tie-break exactly.
+    words = [f"t{i:02d}" for i in range(40)]
+    text = (" ".join(words) + " ") * 3
+    paths = write_inputs(tmp_path, [text])
+    cfg = small_cfg(tmp_path, mesh_shape=4, reduce_n=2)
+    res = run_job(cfg, paths, app=TopK(k=5))
+    assert len(res.table) == 40  # fallback fetched the whole state
+    lines = open(res.output_files[0], "rb").read().splitlines()
+    assert lines == [b"t%02d 3" % i for i in range(5)]
+
+
+# ---- mesh-driver checkpoint / kill / resume (data-plane fault tolerance) --
+
+
+def test_mesh_driver_kill_and_resume_exact(tmp_path):
+    import os
+    import signal
+    import subprocess
+    import sys
+    import textwrap
+    import time
+
+    text = " ".join(f"w{i % 97:03d}" for i in range(40000))
+    paths = write_inputs(tmp_path, [text])
+    work = tmp_path / "work"
+    child = textwrap.dedent(f"""
+        import os, time
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+        from mapreduce_rust_tpu.config import Config
+        import mapreduce_rust_tpu.runtime.driver as drv
+        # Park after the first checkpoint so the parent's SIGKILL is
+        # deterministic mid-stream (no poll race against a fast corpus).
+        _orig = drv._write_ckpt
+        def _park(*a, **k):
+            _orig(*a, **k)
+            time.sleep(300)
+        drv._write_ckpt = _park
+        cfg = Config(chunk_bytes=4096, merge_capacity=1 << 14, reduce_n=4,
+                     mesh_shape=4, checkpoint_every_groups=2,
+                     work_dir={str(work)!r}, output_dir={str(tmp_path / "out")!r},
+                     device="cpu")
+        drv.run_job(cfg, [{paths[0]!r}], write_outputs=False)
+        print("CHILD_FINISHED")
+    """)
+    script = tmp_path / "child.py"
+    script.write_text(child)
+    proc = subprocess.Popen(
+        [sys.executable, str(script)], cwd="/root/repo",
+        env={**os.environ, "PYTHONPATH": "/root/repo"},
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+    )
+    # Kill as soon as the first checkpoint lands (mid-stream).
+    ckpt = work / "driver.ckpt.npz"
+    deadline = time.time() + 120
+    while time.time() < deadline and not ckpt.exists():
+        if proc.poll() is not None:
+            break
+        time.sleep(0.05)
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()
+    out = proc.stdout.read() if proc.stdout else ""
+    assert ckpt.exists(), "no checkpoint was ever written"
+    assert "CHILD_FINISHED" not in out, "child finished before the kill — slow the corpus down"
+
+    # Resume in-process from the journaled checkpoint; counts must be exact.
+    cfg = small_cfg(tmp_path, chunk_bytes=4096, mesh_shape=4, resume=True,
+                    checkpoint_every_groups=2, work_dir=str(work))
+    res = run_job(cfg, paths, write_outputs=False)
+    assert res.table == oracle_counts([text])
+    assert res.stats.unknown_keys == 0
+
+
+def test_mesh_driver_checkpoint_fingerprint_mismatch_ignored(tmp_path):
+    # A checkpoint from a DIFFERENT job (other input) must be ignored.
+    text_a = "alpha beta " * 3000
+    text_b = "gamma delta " * 3000
+    paths_a = write_inputs(tmp_path, [text_a])
+    work = str(tmp_path / "work")
+    cfg = small_cfg(tmp_path, chunk_bytes=2048, mesh_shape=2,
+                    checkpoint_every_groups=1, work_dir=work)
+    run_job(cfg, paths_a, write_outputs=False)
+    (tmp_path / "doc-0.txt").write_bytes(text_b.encode())
+    cfg2 = small_cfg(tmp_path, chunk_bytes=2048, mesh_shape=2, resume=True,
+                     work_dir=work)
+    res = run_job(cfg2, paths_a, write_outputs=False)
+    assert res.table == oracle_counts([text_b])
+
+
+def test_sharded_stream_capacity_fault_replays_exact(tmp_path):
+    # partial_capacity far below per-shard distinct tokens: every group
+    # clamps on device and must be replayed full-width — exact, never
+    # silently dropped.
+    text = " ".join(f"v{i:04d}" for i in range(4000))
+    paths = write_inputs(tmp_path, [text])
+    cfg = small_cfg(tmp_path, mesh_shape=4, sharded_stream=True,
+                    chunk_bytes=2048, partial_capacity=16)
+    res = run_job(cfg, paths, write_outputs=False)
+    assert res.stats.partial_overflow_replays + res.stats.bucket_skew_replays > 0
+    assert res.table == oracle_counts([text])
