@@ -977,6 +977,11 @@ def _stream_mesh(cfg: Config, app: App, inputs, stats, acc, dictionary) -> None:
             and groups_done % cfg.checkpoint_every_groups == 0
         ):
             drain(len(pending))  # state must reflect every submitted group
+            # The dictionary must also reflect them: scan futures fold
+            # lazily, and a checkpointed count whose word never made the
+            # saved dictionary would resume into a permanent unknown key.
+            while ingest.scans:
+                ingest._fold_done(block=True)
             _write_ckpt(cfg, fingerprint, state, groups_done, acc, dictionary, stats)
         elif len(pending) >= 2 * depth:
             drain(depth)

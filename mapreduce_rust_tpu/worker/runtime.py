@@ -85,8 +85,12 @@ class Worker:
         op = self.app.combine_op
         if self.engine == "device":
             return self._map_table_device(doc_id, path, dictionary)
-        # Host engine: the reference's exact per-task work (wc::map +
-        # combiner) at C speed — also the honest multi-process CPU baseline.
+        if op in ("sum", "distinct"):
+            fast = self._map_table_host_native(doc_id, path, dictionary)
+            if fast is not None:
+                return fast, dictionary
+        # Fallback (no native lib, or an op the fused scan doesn't model):
+        # the reference's exact per-task work (wc::map + combiner) in Python.
         counts: collections.Counter = collections.Counter()
         with open(path, "rb") as f:
             for chunk in chunk_stream(f, doc_id, self.cfg.chunk_bytes):
@@ -105,6 +109,33 @@ class Worker:
             else:  # max/min of count within the task — app-defined payloads
                 table[key] = counts[w]
         return table, dictionary
+
+    def _map_table_host_native(self, doc_id: int, path: str,
+                               dictionary: Dictionary):
+        """Map one input with the fused native scan (the driver host-map
+        engine's kernel, native/loader.cpp mr_scan_count): one pass over
+        raw bytes per window instead of normalize+extract+Counter. Returns
+        None when the native lib is unavailable."""
+        from mapreduce_rust_tpu.native.host import scan_count_raw
+        from mapreduce_rust_tpu.runtime.driver import _iter_windows
+        from mapreduce_rust_tpu.runtime.metrics import JobStats
+
+        op = self.app.combine_op
+        table: dict = {}
+        for _doc, window in _iter_windows(self.cfg, [path], JobStats()):
+            res = scan_count_raw(window)
+            if res is None:
+                return None
+            raw, ends, keys, counts = res
+            dictionary.add_scanned_raw(raw, ends, keys)
+            if op == "sum":
+                for (k1, k2), c in zip(keys.tolist(), counts.tolist()):
+                    key = (k1, k2)
+                    table[key] = table.get(key, 0) + c
+            else:  # distinct: the value set is this doc id
+                for k1, k2 in keys.tolist():
+                    table.setdefault((k1, k2), set()).add(doc_id)
+        return table
 
     def _map_table_device(self, doc_id: int, path: str, dictionary: Dictionary):
         from mapreduce_rust_tpu.runtime.driver import HostAccumulator, _stream_single

@@ -54,15 +54,21 @@ _WORKER = textwrap.dedent(
 
 
 def test_two_process_distributed_shuffle(tmp_path):
+    import pathlib
+    import socket
+
+    repo_root = pathlib.Path(__file__).resolve().parents[1]
     script = tmp_path / "worker.py"
     script.write_text(_WORKER)
-    port = "12443"
+    with socket.socket() as s:  # ephemeral free port, no CI collisions
+        s.bind(("127.0.0.1", 0))
+        port = str(s.getsockname()[1])
     procs = [
         subprocess.Popen(
             [sys.executable, str(script), str(pid), port],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            cwd="/root/repo",
-            env={**os.environ, "PYTHONPATH": "/root/repo"},
+            cwd=str(repo_root),
+            env={**os.environ, "PYTHONPATH": str(repo_root)},
         )
         for pid in (0, 1)
     ]

@@ -13,6 +13,7 @@ from mapreduce_rust_tpu.core.normalize import reference_word_counts
 from mapreduce_rust_tpu.runtime.driver import merge_outputs, run_job
 
 CORPUS = pathlib.Path("/root/reference/src/data")
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
 
 SMALL_TEXT = (
     "It is a truth universally acknowledged, that a single man in possession "
@@ -325,8 +326,8 @@ def test_mesh_driver_kill_and_resume_exact(tmp_path):
     script = tmp_path / "child.py"
     script.write_text(child)
     proc = subprocess.Popen(
-        [sys.executable, str(script)], cwd="/root/repo",
-        env={**os.environ, "PYTHONPATH": "/root/repo"},
+        [sys.executable, str(script)], cwd=str(REPO_ROOT),
+        env={**os.environ, "PYTHONPATH": str(REPO_ROOT)},
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
     )
     # Kill as soon as the first checkpoint lands (mid-stream).
