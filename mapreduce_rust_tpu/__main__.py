@@ -72,20 +72,6 @@ def _app(args):
 
 def cmd_run(args) -> int:
     if getattr(args, "distributed", False):
-        if args.num_processes > 1:
-            # The federated data plane exists and is tested at the step
-            # level (parallel/distributed.py + tests/test_distributed.py:
-            # per-process local shards via make_array_from_process_local_data
-            # feeding the global-mesh all_to_all), but the streaming driver
-            # does not yet split ingest per process — running it would read
-            # the whole corpus on every host. Refuse loudly.
-            print(
-                "run --distributed with --num-processes > 1 is not wired into "
-                "the streaming driver yet; see parallel/distributed.py and "
-                "tests/test_distributed.py for the multi-process data plane.",
-                file=sys.stderr,
-            )
-            return 2
         # Before ANY jax call: backend creation binds the process's client.
         from mapreduce_rust_tpu.parallel.distributed import initialize
 
@@ -148,7 +134,7 @@ def cmd_clean(args) -> int:
     if os.path.exists(journal):
         os.remove(journal)
         removed += 1
-    for pattern in ("mr-*.npz", "dict-*.txt"):
+    for pattern in ("mr-*.npz", "dict-*", "driver.ckpt*"):
         for p in glob.glob(os.path.join(args.work, pattern)):
             os.remove(p)
             removed += 1

@@ -76,18 +76,57 @@ def sharded_empty_state(mesh: Mesh, capacity_per_shard: int) -> KVBatch:
     return jax.device_put(stacked, state_sharding(mesh))
 
 
-_SHUFFLE_FNS: dict = {}  # (app, u_cap, bucket_cap, mesh) → (map_shuffle, merge)
+_SHUFFLE_FNS: dict = {}  # (app, u_cap, bucket_cap, mesh, repl) → (map_shuffle, merge)
 
 
-def make_shuffle_step_fns(app: App, u_cap: int, bucket_cap: int, mesh: Mesh):
+def make_shuffle_step_fns(app: App, u_cap: int, bucket_cap: int, mesh: Mesh,
+                          replicate_flags: bool = False):
     """Cached wrapper: apps are frozen dataclasses and Mesh hashes by value,
     so repeated run_job calls in one process reuse the jitted closures
-    (and therefore jax.jit's executable cache) instead of recompiling."""
-    key = (app, u_cap, bucket_cap, mesh)
+    (and therefore jax.jit's executable cache) instead of recompiling.
+
+    replicate_flags=True returns the overflow counters psum-reduced —
+    identical on every chip — for multi-process drivers where no host can
+    see the whole global array (see _chip_shuffle_tail)."""
+    key = (app, u_cap, bucket_cap, mesh, replicate_flags)
     fns = _SHUFFLE_FNS.get(key)
     if fns is None:
-        fns = _SHUFFLE_FNS[key] = _build_shuffle_step_fns(app, u_cap, bucket_cap, mesh)
+        fns = _SHUFFLE_FNS[key] = _build_shuffle_step_fns(
+            app, u_cap, bucket_cap, mesh, replicate_flags
+        )
     return fns
+
+
+def _chip_shuffle_tail(kv: KVBatch, doc_id, app: App, u_cap: int,
+                       bucket_cap: int, d: int, replicate_flags: bool):
+    """THE shuffle body, shared by every map_shuffle variant (chunk-input,
+    kv-input, flag-replicating): device_map → combine → bucket scatter →
+    all_to_all → combine, with the clamp-on-overflow contract: if ANY chip
+    overflowed, every chip's local result clamps to empty (the psum makes
+    them agree) and the driver replays through a wider tier — which is what
+    lets merges dispatch before any flag reaches the host.
+
+    Returns (local KVBatch, p_flag, b_flag): per-chip raw counters, or the
+    psum-reduced (replicated) totals when replicate_flags — the form a
+    multi-process driver needs, since it can only read its own shards."""
+    op = app.combine_op
+    mine = app.device_map(kv, doc_id)
+    partial = count_unique(mine, op=op)
+    update = partial.take_front(u_cap)
+    p_ovf = jnp.sum(partial.valid[u_cap:].astype(jnp.int32))
+    buckets, b_ovf = bucket_scatter(update, num_buckets=d, capacity=bucket_cap)
+    recv = jax.tree.map(
+        lambda x: jax.lax.all_to_all(x, AXIS, split_axis=0, concat_axis=0, tiled=True),
+        buckets,
+    )
+    flat = KVBatch(*(x.reshape(-1) for x in recv))  # [d * bucket_cap]
+    local = count_unique(flat, op=op)  # distinct keys of MY hash class
+    p_tot = jax.lax.psum(p_ovf, AXIS)
+    b_tot = jax.lax.psum(b_ovf, AXIS)
+    local = local._replace(valid=local.valid & ((p_tot + b_tot) == 0))
+    if replicate_flags:
+        return local, p_tot, b_tot
+    return local, p_ovf, b_ovf
 
 
 _KV_SHUFFLE_FNS: dict = {}  # (app, u_cap, bucket_cap, mesh, width) → fn
@@ -108,7 +147,6 @@ def make_kv_shuffle_step_fns(app: App, u_cap: int, bucket_cap: int, mesh: Mesh):
 
 
 def _build_kv_shuffle(app: App, u_cap: int, bucket_cap: int, mesh: Mesh):
-    op = app.combine_op
     d = mesh.devices.size
 
     @jax.jit
@@ -118,20 +156,10 @@ def _build_kv_shuffle(app: App, u_cap: int, bucket_cap: int, mesh: Mesh):
         out_specs=(P(AXIS), P(AXIS), P(AXIS)),
     )
     def map_shuffle_kv(kv: KVBatch, doc_ids: jnp.ndarray):
-        mine = KVBatch(*(x[0] for x in kv))
-        mine = app.device_map(mine, doc_ids[0])
-        partial = count_unique(mine, op=op)
-        update = partial.take_front(u_cap)
-        p_ovf = jnp.sum(partial.valid[u_cap:].astype(jnp.int32))
-        buckets, b_ovf = bucket_scatter(update, num_buckets=d, capacity=bucket_cap)
-        recv = jax.tree.map(
-            lambda x: jax.lax.all_to_all(x, AXIS, split_axis=0, concat_axis=0, tiled=True),
-            buckets,
+        local, p_ovf, b_ovf = _chip_shuffle_tail(
+            KVBatch(*(x[0] for x in kv)), doc_ids[0], app, u_cap, bucket_cap,
+            d, replicate_flags=False,
         )
-        flat = KVBatch(*(x.reshape(-1) for x in recv))
-        local = count_unique(flat, op=op)
-        bad = jax.lax.psum(p_ovf + b_ovf, AXIS) > 0
-        local = local._replace(valid=local.valid & ~bad)
         return (
             KVBatch(*(x[None] for x in local)),
             p_ovf[None],
@@ -141,7 +169,8 @@ def _build_kv_shuffle(app: App, u_cap: int, bucket_cap: int, mesh: Mesh):
     return map_shuffle_kv
 
 
-def _build_shuffle_step_fns(app: App, u_cap: int, bucket_cap: int, mesh: Mesh):
+def _build_shuffle_step_fns(app: App, u_cap: int, bucket_cap: int, mesh: Mesh,
+                            replicate_flags: bool = False):
     """(map_shuffle, merge) — the group-of-D-chunks mesh pipeline.
 
     map_shuffle: chunks [D, chunk_bytes], doc_ids [D] →
@@ -151,20 +180,12 @@ def _build_shuffle_step_fns(app: App, u_cap: int, bucket_cap: int, mesh: Mesh):
         Either nonzero → the driver replays the group through a wider tier
         (bucket_cap=u_cap kills bucket overflow by construction;
         u_cap=chunk capacity kills partial overflow) — results stay exact.
+        The tokenize step is here; everything after is _chip_shuffle_tail.
     merge: (state [D, cap], local) → (state, evicted [D, D*bucket_cap],
         evicted_counts [D]), donating the old state.
     """
     op = app.combine_op
     d = mesh.devices.size
-
-    def _one_chip_map(chunk: jnp.ndarray, doc_id: jnp.ndarray):
-        kv = tokenize_and_hash(chunk)
-        kv = app.device_map(kv, doc_id)
-        partial = count_unique(kv, op=op)
-        update = partial.take_front(u_cap)
-        p_ovf = jnp.sum(partial.valid[u_cap:].astype(jnp.int32))
-        buckets, b_ovf = bucket_scatter(update, num_buckets=d, capacity=bucket_cap)
-        return buckets, p_ovf, b_ovf
 
     @jax.jit
     @functools.partial(
@@ -173,23 +194,10 @@ def _build_shuffle_step_fns(app: App, u_cap: int, bucket_cap: int, mesh: Mesh):
         out_specs=(P(AXIS), P(AXIS), P(AXIS)),
     )
     def map_shuffle(chunks: jnp.ndarray, doc_ids: jnp.ndarray):
-        buckets, p_ovf, b_ovf = _one_chip_map(chunks[0], doc_ids[0])
-        # buckets: [d, bucket_cap] bucket-major — exactly the split layout
-        # all_to_all wants. Row i goes to chip i; chip i concatenates the
-        # d rows it receives (one per source chip).
-        recv = jax.tree.map(
-            lambda x: jax.lax.all_to_all(x, AXIS, split_axis=0, concat_axis=0, tiled=True),
-            buckets,
+        local, p_ovf, b_ovf = _chip_shuffle_tail(
+            tokenize_and_hash(chunks[0]), doc_ids[0], app, u_cap, bucket_cap,
+            d, replicate_flags,
         )
-        flat = KVBatch(*(x.reshape(-1) for x in recv))  # [d * bucket_cap]
-        local = count_unique(flat, op=op)  # distinct keys of MY hash class
-        # If ANY chip overflowed (u_cap truncation or bucket skew), the
-        # whole group clamps to empty — every chip must agree, hence the
-        # psum — and the driver replays it through a wider tier. This lets
-        # the merge dispatch before the flags reach the host, so the stream
-        # loop batches its readbacks into one RPC per pipeline window.
-        bad = jax.lax.psum(p_ovf + b_ovf, AXIS) > 0
-        local = local._replace(valid=local.valid & ~bad)
         return (
             KVBatch(*(x[None] for x in local)),
             p_ovf[None],
@@ -221,3 +229,51 @@ def default_bucket_cap(u_cap: int, n_devices: int, factor: float) -> int:
     the next multiple of 8 for TPU-friendly layouts."""
     cap = math.ceil(u_cap / n_devices * factor)
     return min(u_cap, (cap + 7) // 8 * 8)
+
+
+# ---- multi-host (multi-process) variants ---------------------------------
+#
+# Across processes no host sees the whole of any global array, so every
+# per-group decision the driver makes (replay? keep going?) must come back
+# as a REPLICATED value each process can read from its own local shards.
+# Same kernels otherwise — SPMD means the jitted programs below execute
+# identically on every process over the global mesh.
+
+def make_mh_shuffle_step_fns(app: App, u_cap: int, bucket_cap: int, mesh: Mesh):
+    """(map_shuffle, merge) for multi-process meshes: the standard step fns
+    with psum-REPLICATED overflow flags, so any process reads its local
+    shard and agrees with every other process on whether to replay."""
+    return make_shuffle_step_fns(app, u_cap, bucket_cap, mesh, replicate_flags=True)
+
+
+_ROUND_FNS: dict = {}
+
+
+def make_round_fn(mesh: Mesh):
+    """psum a per-chip int32 over the mesh, returned replicated [D] — the
+    multi-process loop's 'does anyone still have data?' coordinator and,
+    because it is a collective, its round barrier."""
+    fn = _ROUND_FNS.get(mesh)
+    if fn is not None:
+        return fn
+
+    @jax.jit
+    @functools.partial(shard_map, mesh=mesh, in_specs=P(AXIS), out_specs=P(AXIS))
+    def round_flag(flags: jnp.ndarray):
+        return jax.lax.psum(flags[0], AXIS)[None]
+
+    _ROUND_FNS[mesh] = round_flag
+    return round_flag
+
+
+def local_rows(x) -> np.ndarray:
+    """The rows of a [D, ...]-sharded global array owned by THIS process,
+    concatenated in global order — the only part of a global array a
+    multi-process participant may fetch."""
+    shards = sorted(x.addressable_shards, key=lambda s: s.index[0].start or 0)
+    return np.concatenate([np.asarray(s.data) for s in shards])
+
+
+def local_batch(batch: KVBatch) -> KVBatch:
+    """local_rows over every leaf of a sharded KVBatch."""
+    return KVBatch(*(local_rows(x) for x in batch))

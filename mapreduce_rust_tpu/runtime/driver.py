@@ -251,7 +251,8 @@ class _IngestStream:
 
     def __init__(self, cfg: Config, inputs: Sequence[str], stats: JobStats,
                  dictionary: Dictionary, doc_id_offset: int = 0,
-                 skip_chunks: int = 0) -> None:
+                 skip_chunks: int = 0,
+                 doc_ids: "Sequence[int] | None" = None) -> None:
         import queue
         import threading
         from concurrent.futures import ThreadPoolExecutor
@@ -269,6 +270,7 @@ class _IngestStream:
         self.q: "queue.Queue" = queue.Queue(maxsize=max(cfg.prefetch_chunks, 1))
         self.err: BaseException | None = None
         self._stop = False
+        self._doc_ids = list(doc_ids) if doc_ids is not None else None
         self._thread = threading.Thread(
             target=self._produce, args=(list(inputs), stats, doc_id_offset), daemon=True
         )
@@ -288,9 +290,10 @@ class _IngestStream:
     def _produce(self, inputs, stats, doc_id_offset) -> None:
         try:
             for i, path in enumerate(inputs):
+                doc = self._doc_ids[i] if self._doc_ids else doc_id_offset + i
                 stats.bytes_in += os.path.getsize(path)
                 with open(path, "rb") as f:
-                    for chunk in chunk_stream(f, doc_id_offset + i, self.cfg.chunk_bytes):
+                    for chunk in chunk_stream(f, doc, self.cfg.chunk_bytes):
                         stats.chunks += 1
                         stats.forced_cuts += int(chunk.forced_cut)
                         if not self._put(chunk):
@@ -702,6 +705,178 @@ def _load_ckpt(cfg: Config, fingerprint: str):
         return None
 
 
+def _stream_multihost(cfg: Config, app: App, inputs, stats, acc, dictionary) -> None:
+    """The mesh pipeline over a MULTI-PROCESS (jax.distributed) cluster —
+    SURVEY.md §5's comm-backend row closed end-to-end: control stays on the
+    coordinator's RPC plane, data rides XLA collectives over ICI/DCN, and
+    the shared filesystem carries only egress artifacts (dictionaries and
+    partition files), exactly the role it plays for the reference
+    (src/mr/worker.rs:117-140) and for this framework's worker spills.
+
+    Per process: ingest ONLY the inputs assigned to it (round-robin by
+    global doc id), feed its local chips' rows of each global group via
+    make_array_from_process_local_data, and run the same SPMD step programs
+    every other process runs. Per-group decisions (replay? continue?) come
+    back as psum-REPLICATED flags so every process agrees without any host
+    being able to see the whole array. Rounds are lockstep: a process whose
+    inputs are exhausted keeps contributing space-padded groups until the
+    replicated have-data count reaches zero. At the end each process folds
+    only its ADDRESSABLE state/spill shards (its hash classes), publishes
+    its dictionary shard, and merges everyone's — so any process can print
+    words whose bytes were only ever read by another host."""
+    from mapreduce_rust_tpu.parallel.shuffle import (
+        AXIS,
+        default_bucket_cap,
+        local_batch,
+        local_rows,
+        make_mesh,
+        make_mh_shuffle_step_fns,
+        make_round_fn,
+        sharded_empty_state,
+    )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if cfg.checkpoint_every_groups or cfg.resume or cfg.sharded_stream:
+        raise ValueError(
+            "checkpoint/resume and sharded_stream are single-process features"
+        )
+    enable_compilation_cache(cfg.compilation_cache_dir)
+    pid, nproc = jax.process_index(), jax.process_count()
+    mesh = make_mesh(cfg.mesh_shape, None)
+    d = mesh.devices.size
+    d_local = len([dev for dev in mesh.devices.ravel() if dev.process_index == pid])
+    if d_local == 0:
+        raise RuntimeError("this process owns no devices of the mesh")
+    u_cap = cfg.effective_partial_capacity()
+    bucket_cap = default_bucket_cap(u_cap, d, cfg.bucket_capacity_factor)
+    fast = make_mh_shuffle_step_fns(app, u_cap, bucket_cap, mesh)
+    round_fn = make_round_fn(mesh)
+    tiers: dict[str, tuple] = {}
+
+    state = sharded_empty_state(mesh, max(cfg.merge_capacity // d, 16))
+    in_shard = NamedSharding(mesh, P(AXIS))
+    flag_shard = NamedSharding(mesh, P(AXIS))
+
+    # Inputs round-robin by GLOBAL doc id, so inverted_index doc ids match
+    # a single-process run over the same sorted listing.
+    my_inputs = [(i, p) for i, p in enumerate(inputs) if i % nproc == pid]
+    ingest = _IngestStream(
+        cfg, [p for _i, p in my_inputs], stats, dictionary,
+        doc_ids=[i for i, _p in my_inputs],
+    )
+
+    def to_global(local_np: np.ndarray, global_shape):
+        return jax.make_array_from_process_local_data(
+            in_shard, local_np, global_shape=global_shape
+        )
+
+    def fold_local_spill(ev_counts, evicted) -> None:
+        n = int(local_rows(ev_counts).sum())
+        if n > 0:
+            stats.spill_events += 1
+            stats.spilled_keys += n
+            acc.add_batch(local_batch(evicted))
+
+    def run_round(chunks_np: np.ndarray, docs_np: np.ndarray, have: int) -> bool:
+        nonlocal state
+        chunks_g = to_global(chunks_np, (d, cfg.chunk_bytes))
+        docs_g = jax.make_array_from_process_local_data(
+            flag_shard, docs_np, global_shape=(d,)
+        )
+        local, bad_p, bad_b = fast[0](chunks_g, docs_g)
+        state, evicted, ev_counts = fast[1](state, local)
+        flags = round_fn(
+            jax.make_array_from_process_local_data(
+                flag_shard, np.full(d_local, have, dtype=np.int32), global_shape=(d,)
+            )
+        )
+        # Replicated reads, ONE batched fetch: any local shard holds the
+        # global value, and each blocking read is a full round trip.
+        t0 = time.perf_counter()
+        bad_p_l, bad_b_l, flags_l = jax.device_get(
+            [x.addressable_shards[0].data for x in (bad_p, bad_b, flags)]
+        )
+        stats.device_wait_s += time.perf_counter() - t0
+        bad_p_n = int(np.asarray(bad_p_l)[0])
+        bad_b_n = int(np.asarray(bad_b_l)[0])
+        if bad_p_n > 0 or bad_b_n > 0:
+            if bad_p_n > 0:
+                stats.partial_overflow_replays += 1
+                if "full" not in tiers:
+                    tiers["full"] = make_mh_shuffle_step_fns(
+                        app, cfg.chunk_bytes, cfg.chunk_bytes, mesh
+                    )
+                fns = tiers["full"]
+            else:
+                stats.bucket_skew_replays += 1
+                if "skew" not in tiers:
+                    tiers["skew"] = make_mh_shuffle_step_fns(app, u_cap, u_cap, mesh)
+                fns = tiers["skew"]
+            local, _p, _b = fns[0](chunks_g, docs_g)
+            state, evicted2, ev2 = fns[1](state, local)
+            fold_local_spill(ev2, evicted2)
+        fold_local_spill(ev_counts, evicted)
+        return int(np.asarray(flags_l)[0]) > 0
+
+    it = iter(ingest)
+    exhausted = False
+    try:
+        while True:
+            rows: list[np.ndarray] = []
+            docs: list[int] = []
+            while not exhausted and len(rows) < d_local:
+                try:
+                    chunk = next(it)
+                    rows.append(chunk.data)
+                    docs.append(chunk.doc_id)
+                except StopIteration:
+                    exhausted = True
+            have = 1 if rows else 0
+            while len(rows) < d_local:  # pad my contribution with spaces
+                rows.append(np.full(cfg.chunk_bytes, 0x20, dtype=np.uint8))
+                docs.append(0)
+            any_data = run_round(
+                np.stack(rows), np.asarray(docs, dtype=np.int32), have
+            )
+            if not any_data:
+                break
+    except BaseException:
+        ingest.close(abort=True)
+        raise
+    ingest.close()
+    acc.add_batch(local_batch(state))
+
+    # Dictionary exchange over the shared work dir: each process publishes
+    # its shard + a done marker, then merges everyone's (a chip may own
+    # keys whose word bytes were only read by another process). Filenames
+    # embed the job fingerprint so a leftover marker from a DIFFERENT job
+    # in the same work dir can never satisfy — or break — the barrier;
+    # a leftover from the SAME job is the same corpus, hence the same
+    # shard content. (`clean` removes dict-* including markers.)
+    fp = _job_fingerprint(cfg, app, inputs, d)[:16]
+
+    def shard_path(proc: int) -> str:
+        return os.path.join(cfg.work_dir, f"dict-proc-{proc}-{fp}.txt")
+
+    os.makedirs(cfg.work_dir, exist_ok=True)
+    tmp = shard_path(pid) + ".tmp"
+    dictionary.save(tmp)
+    os.replace(tmp, shard_path(pid))
+    open(shard_path(pid) + ".done", "w").close()
+    deadline = time.time() + 120
+    for other in range(nproc):
+        while not (
+            os.path.exists(shard_path(other) + ".done")
+            and os.path.exists(shard_path(other))
+        ):
+            if time.time() > deadline:
+                raise TimeoutError(f"dictionary shard from process {other} never arrived")
+            time.sleep(0.05)
+    for other in range(nproc):
+        if other != pid:
+            dictionary.merge(Dictionary.load(shard_path(other)))
+
+
 def _finish_mesh_state(app: App, mesh, state, stats, acc) -> None:
     """Fold the final sharded state into the host accumulator. Top-k apps
     fetch only per-chip candidates over ICI (parallel/topk.py) when that
@@ -1028,7 +1203,9 @@ def run_job(
         else contextlib.nullcontext()
     )
     with stats.phase("stream"), prof:
-        if cfg.mesh_shape and cfg.mesh_shape > 1 and cfg.sharded_stream:
+        if jax.process_count() > 1:
+            _stream_multihost(cfg, app, inputs, stats, acc, dictionary)
+        elif cfg.mesh_shape and cfg.mesh_shape > 1 and cfg.sharded_stream:
             _stream_sharded(cfg, app, inputs, stats, acc, dictionary)
         elif cfg.mesh_shape and cfg.mesh_shape > 1:
             _stream_mesh(cfg, app, inputs, stats, acc, dictionary)
@@ -1058,8 +1235,12 @@ def run_job(
         parts = app.finalize(items, cfg.reduce_n)
         if write_outputs:
             os.makedirs(cfg.output_dir, exist_ok=True)
+            # Multi-process: each process emits ITS hash classes' lines
+            # under a process-suffixed name; `merge` globs them all (for
+            # top_k, App.merge_lines is the cross-process selection root).
+            suffix = f".p{jax.process_index()}" if jax.process_count() > 1 else ""
             for r in range(cfg.reduce_n):
-                path = os.path.join(cfg.output_dir, f"mr-{r}.txt")
+                path = os.path.join(cfg.output_dir, f"mr-{r}{suffix}.txt")
                 with open(path, "wb") as f:
                     for line in parts.get(r, []):
                         f.write(line + b"\n")
