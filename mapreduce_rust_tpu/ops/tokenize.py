@@ -69,33 +69,58 @@ def _scan_combine_len(x, y):
     return (*out, jnp.where(fy, ly, lx + ly))
 
 
-def _tokenize(chunk: jnp.ndarray, last_is_boundary: bool, with_len: bool):
+def _pallas_eligible(n: int, with_len: bool, use_pallas: bool) -> bool:
+    """Use the fused Pallas kernel (ops/tokenize_pallas.py) when the CALLER
+    says the computation targets a TPU (`use_pallas`) and it applies:
+    block-aligned chunk, no length lane. Measured on v5e: 3.5 ms/MB vs
+    26 ms/MB for the associative_scan — the scan's ~40 log-depth HBM passes
+    collapsed into one. The caller must pass the target platform because
+    under a plugin backend the global default can claim "tpu" while this
+    very computation is placed on CPU devices (Config.device="cpu", the
+    virtual test meshes). MRTPU_NO_PALLAS=1 opts out globally."""
+    import os
+
+    if not use_pallas or with_len or os.environ.get("MRTPU_NO_PALLAS"):
+        return False
+    from mapreduce_rust_tpu.ops.tokenize_pallas import BLOCK
+
+    return n % BLOCK == 0
+
+
+def _tokenize(chunk: jnp.ndarray, last_is_boundary: bool, with_len: bool,
+              use_pallas: bool = False):
     ws_tab, wc_tab = byte_class_tables()
     idx = chunk.astype(jnp.int32)
     is_ws = jnp.take(jnp.asarray(ws_tab), idx).astype(bool)
-    is_wc = jnp.take(jnp.asarray(wc_tab), idx).astype(bool)
 
-    one = jnp.uint32(1)
-    zero = jnp.uint32(0)
-    cplus1 = chunk.astype(jnp.uint32) + one
-    m1 = jnp.where(is_wc, jnp.uint32(H1_MULT), one)
-    a1 = jnp.where(is_wc, cplus1, zero)
-    m2 = jnp.where(is_wc, jnp.uint32(H2_MULT), one)
-    a2 = jnp.where(is_wc, cplus1, zero)
-    cnt = is_wc.astype(jnp.int32)
+    if _pallas_eligible(chunk.shape[0], with_len, use_pallas):
+        from mapreduce_rust_tpu.ops.tokenize_pallas import hash_scan_pallas
 
-    if with_len:
-        blen = (~is_ws).astype(jnp.int32)
-        _, m1s, a1s, m2s, a2s, cnts, tlen = jax.lax.associative_scan(
-            _scan_combine_len, (is_ws, m1, a1, m2, a2, cnt, blen)
-        )
-    else:
-        _, m1s, a1s, m2s, a2s, cnts = jax.lax.associative_scan(
-            _scan_combine, (is_ws, m1, a1, m2, a2, cnt)
-        )
+        h1, h2, cnts = hash_scan_pallas(chunk)
         tlen = None
-    h1 = jnp.uint32(H1_INIT) * m1s + a1s
-    h2 = jnp.uint32(H2_INIT) * m2s + a2s
+    else:
+        is_wc = jnp.take(jnp.asarray(wc_tab), idx).astype(bool)
+        one = jnp.uint32(1)
+        zero = jnp.uint32(0)
+        cplus1 = chunk.astype(jnp.uint32) + one
+        m1 = jnp.where(is_wc, jnp.uint32(H1_MULT), one)
+        a1 = jnp.where(is_wc, cplus1, zero)
+        m2 = jnp.where(is_wc, jnp.uint32(H2_MULT), one)
+        a2 = jnp.where(is_wc, cplus1, zero)
+        cnt = is_wc.astype(jnp.int32)
+
+        if with_len:
+            blen = (~is_ws).astype(jnp.int32)
+            _, m1s, a1s, m2s, a2s, cnts, tlen = jax.lax.associative_scan(
+                _scan_combine_len, (is_ws, m1, a1, m2, a2, cnt, blen)
+            )
+        else:
+            _, m1s, a1s, m2s, a2s, cnts = jax.lax.associative_scan(
+                _scan_combine, (is_ws, m1, a1, m2, a2, cnt)
+            )
+            tlen = None
+        h1 = jnp.uint32(H1_INIT) * m1s + a1s
+        h2 = jnp.uint32(H2_INIT) * m2s + a2s
 
     next_is_ws = jnp.concatenate(
         [is_ws[1:], jnp.full((1,), last_is_boundary, dtype=bool)]
@@ -113,8 +138,9 @@ def _tokenize(chunk: jnp.ndarray, last_is_boundary: bool, with_len: bool):
     return kv, tlen
 
 
-@functools.partial(jax.jit, static_argnames=("last_is_boundary",))
-def tokenize_and_hash(chunk: jnp.ndarray, last_is_boundary: bool = True) -> KVBatch:
+@functools.partial(jax.jit, static_argnames=("last_is_boundary", "use_pallas"))
+def tokenize_and_hash(chunk: jnp.ndarray, last_is_boundary: bool = True,
+                      use_pallas: bool = False) -> KVBatch:
     """Tokenize+hash one uint8 byte chunk.
 
     Args:
@@ -123,11 +149,14 @@ def tokenize_and_hash(chunk: jnp.ndarray, last_is_boundary: bool = True) -> KVBa
       last_is_boundary: whether byte N-1 ends the stream (True for
         whitespace-aligned chunks; False when a halo from the right
         neighbor follows — see parallel/halo.py).
+      use_pallas: the caller targets a TPU — take the fused Mosaic scan
+        (bit-identical; tests/test_tokenize.py) instead of
+        lax.associative_scan.
 
     Returns a KVBatch[N]: valid entries sit at token-end byte positions
     with value 1 (one occurrence).
     """
-    kv, _ = _tokenize(chunk, last_is_boundary, with_len=False)
+    kv, _ = _tokenize(chunk, last_is_boundary, with_len=False, use_pallas=use_pallas)
     return kv
 
 

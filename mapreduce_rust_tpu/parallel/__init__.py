@@ -1,8 +1,17 @@
-"""Parallel tier: mesh construction, ICI all-to-all shuffle, halo exchange."""
+"""Parallel tier: mesh construction, ICI all-to-all shuffle, halo exchange,
+device-side top-k selection, multi-host (jax.distributed) bootstrap."""
 
+from mapreduce_rust_tpu.parallel.distributed import initialize, is_federated  # noqa: F401
+from mapreduce_rust_tpu.parallel.halo import make_sharded_tokenizer, shard_stream  # noqa: F401
 from mapreduce_rust_tpu.parallel.shuffle import (  # noqa: F401
     AXIS,
+    local_batch,
+    local_rows,
+    make_kv_shuffle_step_fns,
     make_mesh,
+    make_mh_shuffle_step_fns,
+    make_round_fn,
     make_shuffle_step_fns,
     sharded_empty_state,
 )
+from mapreduce_rust_tpu.parallel.topk import topk_candidates  # noqa: F401

@@ -186,6 +186,7 @@ def _build_shuffle_step_fns(app: App, u_cap: int, bucket_cap: int, mesh: Mesh,
     """
     op = app.combine_op
     d = mesh.devices.size
+    use_pallas = mesh.devices.ravel()[0].platform == "tpu"
 
     @jax.jit
     @functools.partial(
@@ -195,7 +196,8 @@ def _build_shuffle_step_fns(app: App, u_cap: int, bucket_cap: int, mesh: Mesh,
     )
     def map_shuffle(chunks: jnp.ndarray, doc_ids: jnp.ndarray):
         local, p_ovf, b_ovf = _chip_shuffle_tail(
-            tokenize_and_hash(chunks[0]), doc_ids[0], app, u_cap, bucket_cap,
+            tokenize_and_hash(chunks[0], use_pallas=use_pallas),
+            doc_ids[0], app, u_cap, bucket_cap,
             d, replicate_flags,
         )
         return (

@@ -100,35 +100,36 @@ def select_device(kind: str = "auto"):
     return devs[0]
 
 
-_STEP_FNS: dict = {}  # (app, u_cap) → (map_combine, merge)
+_STEP_FNS: dict = {}  # (app, u_cap, use_pallas) → (map_combine, merge)
 
 
-def make_step_fns(app: App, u_cap: int):
+def make_step_fns(app: App, u_cap: int, use_pallas: bool = False):
     """(map_combine, merge) jitted for one app + update capacity.
 
     map_combine: chunk bytes → compacted per-chunk partial + overflow count.
     merge: fold the partial into the running state, returning the evicted
     tail and its record count (donates the old state's buffers).
+    use_pallas: target is a TPU — tokenize with the fused Mosaic kernel.
 
-    Cached at module level: apps are frozen dataclasses, so (app, u_cap) is
-    a value key and every run_job in a process shares one set of jitted
+    Cached at module level: apps are frozen dataclasses, so the key is a
+    value key and every run_job in a process shares one set of jitted
     closures — a second run hits jax.jit's in-process executable cache
     instead of recompiling (the round-3 bench killer: warm == cold because
     fresh closures were built per call).
     """
-    key = (app, u_cap)
+    key = (app, u_cap, use_pallas)
     fns = _STEP_FNS.get(key)
     if fns is None:
-        fns = _STEP_FNS[key] = _build_step_fns(app, u_cap)
+        fns = _STEP_FNS[key] = _build_step_fns(app, u_cap, use_pallas)
     return fns
 
 
-def _build_step_fns(app: App, u_cap: int):
+def _build_step_fns(app: App, u_cap: int, use_pallas: bool = False):
     op = app.combine_op
 
     @jax.jit
     def map_combine(chunk: jnp.ndarray, doc_id: jnp.ndarray):
-        kv = tokenize_and_hash(chunk)
+        kv = tokenize_and_hash(chunk, use_pallas=use_pallas)
         kv = app.device_map(kv, doc_id)
         partial = count_unique(kv, op=op)
         update = partial.take_front(u_cap)
@@ -356,9 +357,10 @@ def _stream_single(cfg: Config, app: App, inputs, stats, acc, dictionary,
                    doc_id_offset: int = 0) -> None:
     enable_compilation_cache(cfg.compilation_cache_dir)
     device = select_device(cfg.device)
+    use_pallas = device.platform == "tpu"
     u_cap = cfg.effective_partial_capacity()
     depth = max(cfg.pipeline_depth, 1)
-    map_combine, merge = make_step_fns(app, u_cap)
+    map_combine, merge = make_step_fns(app, u_cap, use_pallas)
     slow_fns = None  # full-width replay path, compiled only if ever needed
 
     state = jax.device_put(KVBatch.empty(cfg.merge_capacity), device)
@@ -371,7 +373,7 @@ def _stream_single(cfg: Config, app: App, inputs, stats, acc, dictionary,
         nonlocal state, slow_fns
         stats.partial_overflow_replays += 1
         if slow_fns is None:
-            slow_fns = make_step_fns(app, cfg.chunk_bytes)
+            slow_fns = make_step_fns(app, cfg.chunk_bytes, use_pallas)
         update, _ = slow_fns[0](jax.device_put(chunk_host, device), doc_id)
         state, evicted, ev_count = slow_fns[1](state, update)
         if int(ev_count) > 0:
@@ -742,7 +744,8 @@ def _stream_multihost(cfg: Config, app: App, inputs, stats, acc, dictionary) -> 
         )
     enable_compilation_cache(cfg.compilation_cache_dir)
     pid, nproc = jax.process_index(), jax.process_count()
-    mesh = make_mesh(cfg.mesh_shape, None)
+    backend = None if cfg.device == "auto" else cfg.device
+    mesh = make_mesh(cfg.mesh_shape, backend)
     d = mesh.devices.size
     d_local = len([dev for dev in mesh.devices.ravel() if dev.process_index == pid])
     if d_local == 0:
@@ -770,8 +773,8 @@ def _stream_multihost(cfg: Config, app: App, inputs, stats, acc, dictionary) -> 
             in_shard, local_np, global_shape=global_shape
         )
 
-    def fold_local_spill(ev_counts, evicted) -> None:
-        n = int(local_rows(ev_counts).sum())
+    def fold_local_spill(ev_local: np.ndarray, evicted) -> None:
+        n = int(ev_local.sum())
         if n > 0:
             stats.spill_events += 1
             stats.spilled_keys += n
@@ -790,13 +793,17 @@ def _stream_multihost(cfg: Config, app: App, inputs, stats, acc, dictionary) -> 
                 flag_shard, np.full(d_local, have, dtype=np.int32), global_shape=(d,)
             )
         )
-        # Replicated reads, ONE batched fetch: any local shard holds the
-        # global value, and each blocking read is a full round trip.
+        # ONE batched fetch per round: the replicated flags (any local
+        # shard holds the global value) AND this process's spill counts —
+        # every separate blocking read is a full round trip.
         t0 = time.perf_counter()
-        bad_p_l, bad_b_l, flags_l = jax.device_get(
+        got = jax.device_get(
             [x.addressable_shards[0].data for x in (bad_p, bad_b, flags)]
+            + [s.data for s in ev_counts.addressable_shards]
         )
         stats.device_wait_s += time.perf_counter() - t0
+        bad_p_l, bad_b_l, flags_l = got[:3]
+        ev_local = np.concatenate([np.asarray(x).reshape(-1) for x in got[3:]])
         bad_p_n = int(np.asarray(bad_p_l)[0])
         bad_b_n = int(np.asarray(bad_b_l)[0])
         if bad_p_n > 0 or bad_b_n > 0:
@@ -814,8 +821,8 @@ def _stream_multihost(cfg: Config, app: App, inputs, stats, acc, dictionary) -> 
                 fns = tiers["skew"]
             local, _p, _b = fns[0](chunks_g, docs_g)
             state, evicted2, ev2 = fns[1](state, local)
-            fold_local_spill(ev2, evicted2)
-        fold_local_spill(ev_counts, evicted)
+            fold_local_spill(local_rows(ev2), evicted2)  # rare path: own fetch
+        fold_local_spill(ev_local, evicted)
         return int(np.asarray(flags_l)[0]) > 0
 
     it = iter(ingest)
@@ -853,7 +860,10 @@ def _stream_multihost(cfg: Config, app: App, inputs, stats, acc, dictionary) -> 
     # in the same work dir can never satisfy — or break — the barrier;
     # a leftover from the SAME job is the same corpus, hence the same
     # shard content. (`clean` removes dict-* including markers.)
-    fp = _job_fingerprint(cfg, app, inputs, d)[:16]
+    # nproc is part of the name: same inputs + same d under a different
+    # process split produce different shards, and stale ones must not
+    # satisfy (or poison) the barrier.
+    fp = f"{_job_fingerprint(cfg, app, inputs, d)[:16]}-n{nproc}"
 
     def shard_path(proc: int) -> str:
         return os.path.join(cfg.work_dir, f"dict-proc-{proc}-{fp}.txt")
@@ -1203,6 +1213,11 @@ def run_job(
         else contextlib.nullcontext()
     )
     with stats.phase("stream"), prof:
+        if cfg.map_engine == "host" and cfg.mesh_shape and cfg.mesh_shape > 1:
+            log.warning(
+                "map_engine='host' applies to the single-chip driver only; "
+                "mesh runs tokenize on device (the mesh IS the map engine)"
+            )
         if jax.process_count() > 1:
             _stream_multihost(cfg, app, inputs, stats, acc, dictionary)
         elif cfg.mesh_shape and cfg.mesh_shape > 1 and cfg.sharded_stream:

@@ -86,3 +86,66 @@ def test_unaligned_last_byte_not_boundary():
     batch = tokenize_and_hash(arr, last_is_boundary=False)
     k1 = np.asarray(batch.k1)[np.asarray(batch.valid)]
     assert len(k1) == 1  # only "hello"; "wor" is cut off
+
+
+def test_pallas_scan_matches_associative_scan():
+    """The fused Pallas kernel (interpret mode off-TPU) and the
+    associative_scan must agree bit-for-bit — random bytes cover invalid
+    UTF-8, punctuation runs, and whitespace-free blocks; the corpus slice
+    covers real text."""
+    import pathlib
+
+    import jax.numpy as jnp
+
+    from mapreduce_rust_tpu.core.hashing import byte_class_tables
+    from mapreduce_rust_tpu.ops.tokenize import _tokenize
+    from mapreduce_rust_tpu.ops.tokenize_pallas import BLOCK, hash_scan_pallas
+
+    rng = np.random.default_rng(3)
+    corpus = pathlib.Path("/root/reference/src/data/gut-2.txt")
+    datasets = [rng.integers(0, 256, BLOCK, dtype=np.uint8)]
+    if corpus.exists():
+        raw = corpus.read_bytes()[:BLOCK]
+        datasets.append(np.frombuffer(raw.ljust(BLOCK, b" "), dtype=np.uint8).copy())
+    ws_tab, _wc = byte_class_tables()
+    for data in datasets:
+        h1, h2, cnt = hash_scan_pallas(jnp.asarray(data), interpret=True)
+        kv, _ = _tokenize(jnp.asarray(data), last_is_boundary=True, with_len=False)
+        is_ws = np.asarray(ws_tab)[data].astype(bool)
+        next_ws = np.concatenate([is_ws[1:], [True]])
+        valid = (~is_ws) & next_ws & (np.asarray(cnt) > 0)
+        kv_valid = np.asarray(kv.valid)
+        assert np.array_equal(valid, kv_valid)
+        assert np.array_equal(np.asarray(h1)[valid], np.asarray(kv.k1)[kv_valid])
+        assert np.array_equal(np.asarray(h2)[valid], np.asarray(kv.k2)[kv_valid])
+
+
+def test_pallas_scan_cross_block_carry():
+    """grid >= 2 with a token STRADDLING the 16 KB block boundary — the
+    SMEM carry across grid steps is the kernel's riskiest part and a
+    single-block test can never catch a carry bug."""
+    import jax.numpy as jnp
+
+    from mapreduce_rust_tpu.core.hashing import byte_class_tables, hash_word
+    from mapreduce_rust_tpu.ops.tokenize_pallas import BLOCK, hash_scan_pallas
+
+    n = 2 * BLOCK  # grid=2: interpret-mode compile time grows with grid
+    data = np.full(n, ord(" "), dtype=np.uint8)
+    # A 40-byte token centered on the block boundary, plus a filler.
+    tok = b"straddler_token_across_the_block_edge_xy"
+    start = BLOCK - 20
+    data[start : start + len(tok)] = np.frombuffer(tok, np.uint8)
+    spans = [(start, tok)]
+    data[100:103] = np.frombuffer(b"abc", np.uint8)
+    h1, h2, cnt = hash_scan_pallas(jnp.asarray(data), interpret=True)
+    ws_tab, _ = byte_class_tables()
+    is_ws = np.asarray(ws_tab)[data].astype(bool)
+    next_ws = np.concatenate([is_ws[1:], [True]])
+    valid = (~is_ws) & next_ws & (np.asarray(cnt) > 0)
+    ends = np.nonzero(valid)[0]
+    assert len(ends) == 2  # abc + the straddler
+    got = {e: (int(np.asarray(h1)[e]), int(np.asarray(h2)[e])) for e in ends}
+    assert got[102] == hash_word(b"abc")
+    for start, t in spans:
+        end = start + len(t) - 1
+        assert got[end] == hash_word(t), "cross-block hash carry is broken"
