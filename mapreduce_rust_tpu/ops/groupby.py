@@ -112,6 +112,50 @@ def count_unique(batch: KVBatch, op: str = "sum") -> KVBatch:
     return segment_reduce_sorted(sort_kv(batch, by_value=op in _VALUE_KEYED_OPS), op=op)
 
 
+def compaction_cap(u_cap: int, capacity: int) -> int:
+    """Token-slot budget for compact_front in the map paths — THE single
+    policy both the single-chip and mesh kernels use. Scales with BOTH the
+    distinct-key budget (2*u_cap) and a token-density floor (capacity/4 ≈
+    1.5x typical English density), so tuning partial_capacity down for
+    low-cardinality data cannot strangle the fast path into replaying
+    every chunk; capped at the structural worst case (ceil(capacity/2)
+    one-char tokens), which is what makes full-width replay tiers unable
+    to re-overflow."""
+    return min(max(2 * u_cap, capacity // 4, 1024), capacity // 2 + 1)
+
+
+def compact_front(batch: KVBatch, cap: int) -> tuple[KVBatch, jnp.ndarray]:
+    """Scatter the valid records into the front of a cap-sized batch.
+
+    (packed KVBatch[cap], overflow_count). The device map step's sort
+    (count_unique) costs O(N log N) over EVERY byte position of a chunk,
+    but only ~N/6 positions hold tokens in real text — compacting first
+    makes the sort pay for tokens, not bytes. Records past cap are counted,
+    never dropped silently: the driver replays the chunk through a tier
+    whose cap is the exact worst case (ceil(N/2) one-char tokens), the same
+    contract as every other capacity fault.
+    """
+    n = batch.capacity
+    idx = jnp.cumsum(batch.valid.astype(jnp.int32)) - 1
+    total = idx[n - 1] + 1
+    ovf = jnp.maximum(total - cap, 0)
+    # Invalid records and overflow scatter into the dump slot at cap.
+    dest = jnp.where(batch.valid & (idx < cap), idx, cap)
+    sent = jnp.uint32(SENTINEL)
+
+    def place(x, fill):
+        buf = jnp.full((cap + 1,), fill, x.dtype)
+        return buf.at[dest].set(x, mode="drop")[:cap]
+
+    packed = KVBatch(
+        k1=place(batch.k1, sent),
+        k2=place(batch.k2, sent),
+        value=place(batch.value, jnp.int32(0)),
+        valid=jnp.arange(cap, dtype=jnp.int32) < jnp.minimum(total, cap),
+    )
+    return packed, ovf
+
+
 def concat_batches(a: KVBatch, b: KVBatch) -> KVBatch:
     return KVBatch(
         k1=jnp.concatenate([a.k1, b.k1]),

@@ -44,7 +44,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from mapreduce_rust_tpu.apps.base import App
 from mapreduce_rust_tpu.core.kv import KVBatch
-from mapreduce_rust_tpu.ops.groupby import count_unique, merge_batches
+from mapreduce_rust_tpu.ops.groupby import (
+    compact_front,
+    compaction_cap,
+    count_unique,
+    merge_batches,
+)
 from mapreduce_rust_tpu.ops.partition import bucket_scatter
 from mapreduce_rust_tpu.ops.tokenize import tokenize_and_hash
 
@@ -110,10 +115,13 @@ def _chip_shuffle_tail(kv: KVBatch, doc_id, app: App, u_cap: int,
     psum-reduced (replicated) totals when replicate_flags — the form a
     multi-process driver needs, since it can only read its own shards."""
     op = app.combine_op
+    # Compact before sorting — count_unique pays for tokens, not byte
+    # positions; ops/groupby.compaction_cap is the shared sizing policy.
+    kv, c_ovf = compact_front(kv, compaction_cap(u_cap, kv.capacity))
     mine = app.device_map(kv, doc_id)
     partial = count_unique(mine, op=op)
     update = partial.take_front(u_cap)
-    p_ovf = jnp.sum(partial.valid[u_cap:].astype(jnp.int32))
+    p_ovf = jnp.sum(partial.valid[u_cap:].astype(jnp.int32)) + c_ovf
     buckets, b_ovf = bucket_scatter(update, num_buckets=d, capacity=bucket_cap)
     recv = jax.tree.map(
         lambda x: jax.lax.all_to_all(x, AXIS, split_axis=0, concat_axis=0, tiled=True),
@@ -175,8 +183,10 @@ def _build_shuffle_step_fns(app: App, u_cap: int, bucket_cap: int, mesh: Mesh,
 
     map_shuffle: chunks [D, chunk_bytes], doc_ids [D] →
         (local KVBatch [D, D*bucket_cap], partial_ovf [D], bucket_ovf [D]).
-        partial_ovf counts distinct keys truncated by the u_cap compaction;
-        bucket_ovf counts records dropped by bucket skew beyond bucket_cap.
+        partial_ovf counts capacity faults on the map side — distinct keys
+        past u_cap plus raw tokens past the compaction cap
+        (ops/groupby.compaction_cap); bucket_ovf counts records dropped by
+        bucket skew beyond bucket_cap.
         Either nonzero → the driver replays the group through a wider tier
         (bucket_cap=u_cap kills bucket overflow by construction;
         u_cap=chunk capacity kills partial overflow) — results stay exact.

@@ -187,3 +187,27 @@ def test_bucket_scatter_preserves_totals_random():
     for a, b, v in zip(k1[ok].tolist(), k2[ok].tolist(), vv[ok].tolist()):
         got[(a, b)] += v
     assert got == oracle
+
+
+def test_compact_front_exact_and_overflow():
+    from mapreduce_rust_tpu.ops.groupby import compact_front
+
+    rng = np.random.default_rng(5)
+    n = 4096
+    valid = rng.random(n) < 0.2
+    k1 = rng.integers(0, 2**32, n, dtype=np.uint32)
+    k2 = rng.integers(0, 2**32, n, dtype=np.uint32)
+    val = rng.integers(0, 100, n, dtype=np.int32)
+    batch = KVBatch(jnp.asarray(k1), jnp.asarray(k2), jnp.asarray(val), jnp.asarray(valid))
+    total = int(valid.sum())
+    # Roomy cap: everything packed, order preserved, nothing lost.
+    packed, ovf = compact_front(batch, cap=total + 7)
+    assert int(ovf) == 0
+    assert int(np.asarray(packed.valid).sum()) == total
+    assert np.array_equal(np.asarray(packed.k1)[:total], k1[valid])
+    assert np.array_equal(np.asarray(packed.value)[:total], val[valid])
+    # Tight cap: overflow counted, the first cap records kept in order.
+    cap = total // 2
+    packed2, ovf2 = compact_front(batch, cap=cap)
+    assert int(ovf2) == total - cap
+    assert np.array_equal(np.asarray(packed2.k1)[:cap], k1[valid][:cap])
