@@ -1,0 +1,38 @@
+"""The BENCH artifact contract: bench.py must ALWAYS print exactly one
+parseable JSON line on stdout with the agreed keys — three rounds were lost
+to a bench that died before printing (VERDICT r3). Runs tiny (2 MB corpus,
+CPU-XLA device leg) but through the real harness path: corpus build, CPU
+baseline pool, device-leg subprocess, JSON emission."""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+def test_bench_prints_contract_json_line():
+    env = {
+        **os.environ,
+        "PYTHONPATH": str(REPO_ROOT),
+        "JAX_PLATFORMS": "cpu",
+        "BENCH_TARGET_MB": "2",
+        "BENCH_BASELINE_MB": "1",
+        "BENCH_FALLBACK_MB": "1",
+        "BENCH_DEVICE_TIMEOUT_S": "240",
+        "BENCH_FALLBACK_TIMEOUT_S": "240",
+    }
+    r = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "bench.py")],
+        capture_output=True, text=True, timeout=500, env=env, cwd=str(REPO_ROOT),
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [l for l in r.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected exactly one stdout line, got {lines}"
+    parsed = json.loads(lines[0])
+    assert set(parsed) >= {"metric", "value", "unit", "vs_baseline"}, parsed
+    assert parsed["unit"] == "GB/s"
+    assert parsed["value"] is None or parsed["value"] > 0
+    assert "error" not in parsed, parsed.get("error")

@@ -1,0 +1,46 @@
+"""The driver's two artifact entry points must hold up in hostile
+environments: entry() compile-checks anywhere, and dryrun_multichip stays
+green even when the calling process is poisoned with a broken TPU plugin
+env — exactly the rounds-2/3 failure mode (a wedged/version-skewed plugin
+failing a virtual-CPU-mesh correctness check)."""
+
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+def test_entry_is_jittable():
+    sys.path.insert(0, str(REPO_ROOT))
+    try:
+        import __graft_entry__ as g
+
+        fn, args = g.entry()
+        import jax
+
+        out = jax.jit(fn)(*args)
+        jax.block_until_ready(out)
+    finally:
+        sys.path.remove(str(REPO_ROOT))
+
+
+def test_dryrun_multichip_survives_poisoned_tpu_env():
+    env = {
+        **os.environ,
+        "PYTHONPATH": str(REPO_ROOT),
+        # Garbage TPU plugin settings: the hermetic re-exec must scrub these.
+        "TPU_LIBRARY_PATH": "/nonexistent/libtpu.so",
+        "TPU_WORKER_HOSTNAMES": "garbage:99999",
+        "PJRT_DEVICE": "NONSENSE",
+    }
+    r = subprocess.run(
+        [
+            sys.executable, "-c",
+            "import __graft_entry__ as g; g.dryrun_multichip(4)",
+        ],
+        capture_output=True, text=True, timeout=300, env=env, cwd=str(REPO_ROOT),
+    )
+    assert r.returncode == 0, (r.stdout[-500:], r.stderr[-2000:])
+    assert "ok — " in r.stdout
