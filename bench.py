@@ -46,6 +46,15 @@ BASELINE_MB = int(os.environ.get("BENCH_BASELINE_MB", "32"))
 FALLBACK_MB = int(os.environ.get("BENCH_FALLBACK_MB", "16"))
 DEVICE_TIMEOUT_S = int(os.environ.get("BENCH_DEVICE_TIMEOUT_S", "300"))
 FALLBACK_TIMEOUT_S = int(os.environ.get("BENCH_FALLBACK_TIMEOUT_S", "150"))
+# Deadline for the device leg's BENCH_DEVICE_READY heartbeat (backend
+# init), NOT for the run — see _run_device_leg.
+PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "90"))
+
+
+# Why JAX_PLATFORMS=cpu alone is not hermetic: see ACCEL_ENV_PREFIXES there.
+from __graft_entry__ import cpu_only_env as _cpu_env  # noqa: E402
+
+
 
 _WS = b" \t\n\r\x0b\x0c"
 
@@ -112,6 +121,18 @@ def cpu_baseline_gbs(path: pathlib.Path, limit_bytes: int, workers: int = 8) -> 
 def device_leg(path: str) -> None:
     """Runs INSIDE the bench subprocess: full framework path, prints one
     JSON line {gbs, info} on stdout."""
+    import jax
+
+    # Heartbeat the parent waits on with a short deadline: backend init is
+    # where a wedged accelerator tunnel hangs FOREVER (no timeout in the
+    # plugin), and it is also the only phase a healthy-but-cold device
+    # spends more than a few seconds in before output appears. Printing it
+    # AFTER jax.devices() means: heartbeat seen = init succeeded, run on;
+    # no heartbeat by the deadline = wedged, kill and fall back without
+    # burning the whole DEVICE_TIMEOUT_S.
+    print(f"BENCH_DEVICE_READY {jax.devices()[0].platform}",
+          file=sys.stderr, flush=True)
+
     from mapreduce_rust_tpu.config import Config
     from mapreduce_rust_tpu.runtime.driver import enable_compilation_cache, run_job
 
@@ -163,32 +184,96 @@ def _platform_name() -> str:
         return "unknown"
 
 
-def _run_device_subprocess(corpus: pathlib.Path, timeout_s: int, env_extra: dict):
-    """Launch the device leg; return (parsed dict | None, error string | None)."""
-    env = dict(os.environ, **env_extra)
+def _run_device_leg(corpus: pathlib.Path, timeout_s: int, env: dict | None,
+                    init_timeout_s: int | None = None):
+    """Launch the device leg; return (parsed dict | None, error string | None).
+
+    env is the child's FULL environment (None = inherit ambient).
+    init_timeout_s bounds time-to-heartbeat (BENCH_DEVICE_READY on stderr,
+    printed right after jax.devices() in the child): a wedged accelerator
+    plugin hangs in backend init with NO timeout of its own, and without
+    this deadline it would silently eat the whole timeout_s before the CPU
+    fallback could start. A healthy-but-cold device only has to clear the
+    init deadline, then gets the full timeout_s for the run itself —
+    probing init in a separate throwaway process would instead pay backend
+    init twice per run and forfeit slow-but-healthy devices entirely.
+    """
+    import threading
+
+    proc = subprocess.Popen(
+        [sys.executable, str(REPO / "bench.py"), "--device-leg", str(corpus)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=dict(os.environ) if env is None else env, cwd=str(REPO),
+    )
+    ready = threading.Event()
+    err_chunks: list[str] = []
+    out_chunks: list[str] = []
+
+    # Both pipes are drained concurrently (a full, unread pipe would block
+    # the child mid-write and masquerade as a timeout here).
+    def _pump_err() -> None:
+        for line in proc.stderr:
+            err_chunks.append(line)
+            if "BENCH_DEVICE_READY" in line:
+                ready.set()
+
+    def _pump_out() -> None:
+        for line in proc.stdout:
+            out_chunks.append(line)
+
+    pumps = [
+        threading.Thread(target=_pump_err, daemon=True),
+        threading.Thread(target=_pump_out, daemon=True),
+    ]
+    for p in pumps:
+        p.start()
     try:
-        r = subprocess.run(
-            [sys.executable, str(REPO / "bench.py"), "--device-leg", str(corpus)],
-            capture_output=True, text=True, timeout=timeout_s, env=env, cwd=str(REPO),
-        )
-    except subprocess.TimeoutExpired:
-        return None, f"device leg timed out after {timeout_s}s"
-    sys.stderr.write(r.stderr[-4000:])
-    for line in reversed(r.stdout.splitlines()):
+        if init_timeout_s is not None:
+            deadline = time.monotonic() + init_timeout_s
+            # A child that EXITS before the heartbeat (import error, bad
+            # path, instant plugin abort) must be reported by its rc and
+            # stderr tail, not mislabeled a wedge after the full deadline.
+            while (
+                not ready.is_set()
+                and proc.poll() is None
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.2)
+            if not ready.is_set() and proc.poll() is None:
+                return None, (
+                    f"device backend init: no heartbeat within {init_timeout_s}s "
+                    "(wedged accelerator plugin?)"
+                )
+        try:
+            proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            return None, f"device leg timed out after {timeout_s}s"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+        # The child is dead: its pipe ends are closed, so EOF is guaranteed
+        # and the pumps finish once the (possibly multi-MB) residue drains.
+        # The generous bound only guards a pathological descendant holding
+        # the write end open.
+        for p in pumps:
+            p.join(timeout=30)
+        sys.stderr.write("".join(err_chunks)[-4000:])
+    out = "".join(out_chunks)
+    for line in reversed(out.splitlines()):
         line = line.strip()
         if line.startswith("{"):
             try:
                 return json.loads(line), None
             except json.JSONDecodeError:
                 break
-    tail = (r.stderr or r.stdout or "").strip().splitlines()
-    return None, f"device leg rc={r.returncode}: {tail[-1] if tail else 'no output'}"
+    tail = ("".join(err_chunks) or out).strip().splitlines()
+    return None, f"device leg rc={proc.returncode}: {tail[-1] if tail else 'no output'}"
 
 
 def main() -> None:
     errors: list[str] = []
     base_gbs = None
-    dev = None
     fallback = False
 
     try:
@@ -212,14 +297,20 @@ def main() -> None:
     # Median of three device runs — the SAME estimator as the CPU baseline
     # (an asymmetric max-vs-median pairing would bias the ratio upward).
     # Repeats are skipped when the first run was slow (cold compiles /
-    # sick machine): one number beats a harness-level timeout.
+    # sick machine): one number beats a harness-level timeout. The
+    # heartbeat init deadline applies to every attempt: a backend that
+    # wedges mid-bench (not just before it) still can't eat the leg.
     t0 = time.perf_counter()
-    dev, err = _run_device_subprocess(corpus, DEVICE_TIMEOUT_S, {})
+    dev, err = _run_device_leg(
+        corpus, DEVICE_TIMEOUT_S, None, init_timeout_s=PROBE_TIMEOUT_S
+    )
     first_wall = time.perf_counter() - t0
     if dev is not None and first_wall < DEVICE_TIMEOUT_S / 3:
         more = [dev]
         for _ in range(2):
-            r, _e = _run_device_subprocess(corpus, DEVICE_TIMEOUT_S, {})
+            r, _e = _run_device_leg(
+                corpus, DEVICE_TIMEOUT_S, None, init_timeout_s=PROBE_TIMEOUT_S
+            )
             if r is not None:
                 more.append(r)
         dev = sorted(more, key=lambda r: r["gbs"])[len(more) // 2]
@@ -227,8 +318,8 @@ def main() -> None:
         errors.append(err)
         fallback = True
         small = build_corpus(FALLBACK_MB)
-        dev, err = _run_device_subprocess(
-            small, FALLBACK_TIMEOUT_S, {"JAX_PLATFORMS": "cpu"}
+        dev, err = _run_device_leg(
+            small, FALLBACK_TIMEOUT_S, _cpu_env(), init_timeout_s=PROBE_TIMEOUT_S
         )
         if dev is None:
             errors.append(f"fallback: {err}")

@@ -89,10 +89,35 @@ def enable_compilation_cache(path: str | None = "auto") -> None:
             os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
             ".jax_cache",
         )
+    # Scope by host fingerprint: XLA's CPU cache key does NOT cover the
+    # host's instruction-set features — an entry AOT-compiled on another
+    # machine image loads with a "could lead to SIGILL" warning and may do
+    # exactly that. A per-(jax, arch, cpu-flags) subdir turns cross-machine
+    # reuse into a clean cold compile instead of a potential crash.
+    path = os.path.join(path, _host_fingerprint())
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     _cc_enabled = True
+
+
+def _host_fingerprint() -> str:
+    import hashlib
+    import platform
+
+    try:
+        with open("/proc/cpuinfo") as f:
+            # x86 spells it "flags", aarch64 "Features" — either carries the
+            # ISA extensions whose mismatch makes a foreign AOT result crash.
+            flags = next(
+                (l for l in f if l.startswith(("flags", "Features"))), ""
+            )
+    except OSError:
+        flags = ""
+    h = hashlib.sha256(
+        f"{jax.__version__}:{platform.machine()}:{flags}".encode()
+    ).hexdigest()[:12]
+    return f"{platform.machine()}-{h}"
 
 
 def select_device(kind: str = "auto"):

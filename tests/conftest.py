@@ -3,13 +3,37 @@
 This simulates the v5e-8 mesh on the single-host test machine
 (SURVEY.md §4): shard_map/all_to_all code paths run unchanged; the driver
 separately dry-run-compiles the multi-chip path via __graft_entry__.py.
+
+Hermeticity against the host image's accelerator plugin: a sitecustomize
+on PYTHONPATH may register an experimental TPU-tunnel PJRT plugin in
+EVERY interpreter and then override ``jax_platforms`` by direct
+``jax.config.update`` — which silently defeats the JAX_PLATFORMS env var
+(a wedged tunnel then hangs any process that reaches jax.devices(), with
+no timeout). Two counters, both needed:
+  - in THIS process: jax.config.update back to "cpu" (config beats config);
+  - for every CHILD the tests spawn: scrub the plugin's gate variables from
+    os.environ so the sitecustomize registration body never runs, making
+    the inherited JAX_PLATFORMS=cpu effective again.
 """
 
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from __graft_entry__ import ACCEL_ENV_PREFIXES  # noqa: E402  (shared scrub list)
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+for _k in list(os.environ):
+    # PALLAS_AXON_POOL_IPS gates the sitecustomize plugin registration;
+    # the rest are its tunnel/TPU configuration. All irrelevant on CPU.
+    if _k.startswith(ACCEL_ENV_PREFIXES):
+        os.environ.pop(_k, None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402  (import order is the point)
+
+jax.config.update("jax_platforms", "cpu")

@@ -13,27 +13,34 @@ REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
 
 
 def test_entry_is_jittable():
-    sys.path.insert(0, str(REPO_ROOT))
-    try:
-        import __graft_entry__ as g
+    import __graft_entry__ as g  # conftest puts the repo root on sys.path
 
-        fn, args = g.entry()
-        import jax
+    fn, args = g.entry()
+    import jax
 
-        out = jax.jit(fn)(*args)
-        jax.block_until_ready(out)
-    finally:
-        sys.path.remove(str(REPO_ROOT))
+    out = jax.jit(fn)(*args)
+    jax.block_until_ready(out)
 
 
 def test_dryrun_multichip_survives_poisoned_tpu_env():
     env = {
         **os.environ,
-        "PYTHONPATH": str(REPO_ROOT),
+        # Keep the ambient PYTHONPATH tail: on the real image it carries the
+        # sitecustomize whose plugin registration the gate var below arms,
+        # so the child reproduces the full hostile chain, not a mock of it.
+        "PYTHONPATH": os.pathsep.join(
+            p for p in [str(REPO_ROOT), os.environ.get("PYTHONPATH", "")] if p
+        ),
         # Garbage TPU plugin settings: the hermetic re-exec must scrub these.
         "TPU_LIBRARY_PATH": "/nonexistent/libtpu.so",
         "TPU_WORKER_HOSTNAMES": "garbage:99999",
         "PJRT_DEVICE": "NONSENSE",
+        # The round-4 wedge: the image's sitecustomize registers its tunnel
+        # plugin whenever this gate var is set and then overrides
+        # jax_platforms by jax.config.update — JAX_PLATFORMS=cpu in a child
+        # env is NOT enough; the gate vars themselves must be scrubbed.
+        "PALLAS_AXON_POOL_IPS": "127.0.0.1",
+        "JAX_PLATFORMS": "axon",
     }
     r = subprocess.run(
         [
