@@ -44,10 +44,14 @@ def sort_kv(batch: KVBatch, by_value: bool = False) -> KVBatch:
     uint32, so padding keys dominate the comparison before value is reached).
     """
     num_keys = 3 if by_value else 2
+    # Unstable: ~25% cheaper comparator (measured on XLA CPU at 320K rows,
+    # 163→123 ms) and tie order is immaterial — every consumer aggregates
+    # whole key segments (segment_reduce_sorted), so records tied on the
+    # full key set produce identical segment results in any order.
     k1, k2, value, valid = jax.lax.sort(
         (batch.k1, batch.k2, batch.value, batch.valid.astype(jnp.int32)),
         num_keys=num_keys,
-        is_stable=True,
+        is_stable=False,
     )
     return KVBatch(k1, k2, value, valid.astype(bool))
 

@@ -43,11 +43,15 @@ def bucket_scatter(
         jnp.int32(num_buckets),  # invalid → virtual overflow bucket, dropped
     )
 
-    # Stable sort by bucket so each bucket's records are contiguous.
+    # Sort by bucket so each bucket's records are contiguous. Unstable is
+    # safe: within a bucket, downstream merges are order-free (segment
+    # reduce after re-sort), and WHICH records survive a capacity overflow
+    # is immaterial because any overflow>0 makes the driver replay the
+    # whole group through a wider tier anyway.
     sb, sk1, sk2, sval, svalid = jax.lax.sort(
         (bucket, batch.k1, batch.k2, batch.value, batch.valid.astype(jnp.int32)),
         num_keys=1,
-        is_stable=True,
+        is_stable=False,
     )
     pos = jnp.arange(n, dtype=jnp.int32)
     # First index of each bucket via segment_min over sorted bucket ids.
