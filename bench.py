@@ -46,10 +46,11 @@ BASELINE_MB = int(os.environ.get("BENCH_BASELINE_MB", "32"))
 # Fallback is sized so fixed costs (state egress, 46K-key dictionary
 # finalize, jit dispatch) amortize: measured 0.017 GB/s at 8 MB,
 # 0.078 GB/s at 64 MB, 0.122 GB/s (exact, 13× baseline) at 1 GB for the
-# identical CPU-XLA pipeline. Defaulting to TARGET_MB reuses the main
-# leg's corpus file — no extra build — and ~5 s of compute at 512 MB
-# leaves the 150 s budget as pure compile headroom.
-FALLBACK_MB = int(os.environ.get("BENCH_FALLBACK_MB", str(TARGET_MB)))
+# identical CPU-XLA pipeline. Default = the main leg's corpus (no extra
+# build), CAPPED at 512 MB so the leg stays inside its fixed
+# FALLBACK_TIMEOUT_S even when BENCH_TARGET_MB is cranked to 10 GB
+# (~5 s of compute at 512 MB; the rest of the budget is compile headroom).
+FALLBACK_MB = int(os.environ.get("BENCH_FALLBACK_MB", str(min(TARGET_MB, 512))))
 DEVICE_TIMEOUT_S = int(os.environ.get("BENCH_DEVICE_TIMEOUT_S", "300"))
 FALLBACK_TIMEOUT_S = int(os.environ.get("BENCH_FALLBACK_TIMEOUT_S", "150"))
 # Deadline for the device leg's BENCH_DEVICE_READY heartbeat (backend
@@ -85,9 +86,11 @@ def build_corpus(target_mb: int) -> pathlib.Path:
                 f.write(b"\n")
                 written += len(seed) + 1
     except BaseException:
-        # A partial oversized file must not survive: it would satisfy the
-        # size check of a SMALLER retry (shrink-on-disk-pressure) never —
-        # worse, it keeps the disk full so the shrink fails too.
+        # Unlink the partial file: it pins the disk space a shrink retry
+        # needs, and an interrupted loop that had already crossed the
+        # target size would satisfy the >= check of a later SAME-size run
+        # with a torn tail. (Different sizes use different filenames, so
+        # cross-size staleness is not the hazard here.)
         try:
             out.unlink()
         except OSError:
@@ -333,9 +336,9 @@ def main() -> None:
         fallback = True
         try:
             small = build_corpus(FALLBACK_MB)
-        except Exception as e:  # disk pressure — shrink, never die
+        except Exception as e:  # disk pressure — reuse what exists, never die
             errors.append(f"fallback corpus: {e!r}")
-            small = build_corpus(8)
+            small = corpus  # already on disk (possibly the shrunken one)
         dev, err = _run_device_leg(
             small, FALLBACK_TIMEOUT_S, _cpu_env(), init_timeout_s=PROBE_TIMEOUT_S
         )

@@ -40,3 +40,28 @@ def test_bench_prints_contract_json_line():
     assert parsed["unit"] == "GB/s"
     assert parsed["value"] is None or parsed["value"] > 0
     assert "error" not in parsed, parsed.get("error")
+
+
+def test_device_leg_fast_crash_reports_rc_not_wedge(tmp_path):
+    """A child that EXITS BEFORE the heartbeat (backend init raises
+    promptly — e.g. an unknown platform — rather than hanging) must
+    surface its rc and stderr tail within seconds: the init-wait loop's
+    proc.poll() short-circuit, not the full deadline + a bogus 'wedged
+    plugin' label."""
+    import pathlib
+    import time
+
+    sys.path.insert(0, str(REPO_ROOT))
+    import bench
+
+    corpus = tmp_path / "c.txt"
+    corpus.write_bytes(b"a b c\n")
+    env = {**bench._cpu_env(), "JAX_PLATFORMS": "bogus_platform"}
+    t0 = time.time()
+    dev, err = bench._run_device_leg(
+        pathlib.Path(corpus), 60, env, init_timeout_s=60
+    )
+    dt = time.time() - t0
+    assert dev is None
+    assert "rc=" in err and "heartbeat" not in err, err
+    assert dt < 30, f"crash took {dt:.1f}s — init deadline was not short-circuited"
