@@ -336,9 +336,15 @@ def main() -> None:
         fallback = True
         try:
             small = build_corpus(FALLBACK_MB)
-        except Exception as e:  # disk pressure — reuse what exists, never die
+        except Exception as e:  # disk pressure — shrink, never die
             errors.append(f"fallback corpus: {e!r}")
-            small = corpus  # already on disk (possibly the shrunken one)
+            try:
+                small = build_corpus(8)
+            except Exception:
+                # Not even 8 MB fits: reuse whatever the main leg had. This
+                # may exceed the leg's time budget if it is the full-size
+                # corpus, but it is the only measurable byte stream left.
+                small = corpus
         dev, err = _run_device_leg(
             small, FALLBACK_TIMEOUT_S, _cpu_env(), init_timeout_s=PROBE_TIMEOUT_S
         )

@@ -34,6 +34,8 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--work", default="mr-work")
     p.add_argument("--app", default="word_count", choices=sorted(REGISTRY))
     p.add_argument("--k", type=int, default=20, help="top_k selection size")
+    p.add_argument("--query", default="",
+                   help="grep: comma-separated words to search for")
     p.add_argument("--reduce-n", type=int, default=4)
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=1040)
@@ -67,7 +69,16 @@ def _cfg(args, map_n: int = 1, worker_n: int = 1) -> Config:
 
 
 def _app(args):
-    return get_app(args.app, k=args.k) if args.app == "top_k" else get_app(args.app)
+    if args.app == "top_k":
+        return get_app(args.app, k=args.k)
+    if args.app == "grep":
+        from mapreduce_rust_tpu.apps.grep import _query_keys
+
+        query = tuple(w for w in args.query.split(",") if w)
+        _query_keys(query)  # validate NOW — a bad --query is a CLI error,
+        # not a mid-run traceback inside every worker's map task
+        return get_app(args.app, query=query)
+    return get_app(args.app)
 
 
 def cmd_run(args) -> int:

@@ -4,6 +4,7 @@ the CLI/driver boundary instead of compile-time-fixed boxed fns
 (src/mr/worker.rs:148,175)."""
 
 from mapreduce_rust_tpu.apps.base import App  # noqa: F401
+from mapreduce_rust_tpu.apps.grep import Grep  # noqa: F401
 from mapreduce_rust_tpu.apps.inverted_index import InvertedIndex  # noqa: F401
 from mapreduce_rust_tpu.apps.top_k import TopK  # noqa: F401
 from mapreduce_rust_tpu.apps.word_count import WordCount  # noqa: F401
@@ -12,6 +13,7 @@ REGISTRY: dict[str, type[App]] = {
     "word_count": WordCount,
     "inverted_index": InvertedIndex,
     "top_k": TopK,
+    "grep": Grep,
 }
 
 

@@ -56,6 +56,13 @@ class App:
         global top-k over scalar values."""
         return None
 
+    def host_mask(self, keys) -> "object | None":
+        """Host-map-engine twin of a FILTERING device_map: given the
+        window's unique keys (uint32 [n, 2]), return a bool[n] keep-mask,
+        or None (default) for keep-everything. Applied by the host engines
+        BEFORE host_values, whose inputs are already filtered."""
+        return None
+
     def host_values(self, counts, doc_id: int):
         """Host-map-engine counterpart of device_map: values for one
         window's unique keys, given their occurrence counts (uint32[n]).

@@ -276,6 +276,41 @@ def _scan_payload(payload: bytes):
     return ("list", words, hash_words(words))
 
 
+def _slice_words(raw: bytes, ends: np.ndarray, idx) -> list[bytes]:
+    """Materialize words idx (ascending indices) of a concatenated
+    (raw, ends) scan result."""
+    ends_l = ends.tolist()
+    return [raw[(ends_l[i - 1] if i else 0): ends_l[i]] for i in idx]
+
+
+def fold_scan_into_dictionary(dictionary: Dictionary, host_mask, kind, parts) -> None:
+    """Fold one tagged scan result — ("raw", raw, ends, keys[, ...]) or
+    ("list", words, keys[, ...]) — into the egress dictionary, restricted
+    to the keys a filtering app keeps (App.host_mask). For grep-style apps
+    the dictionary then scales with the QUERY, not the corpus vocabulary —
+    non-query words are never materialized or inserted. host_mask returning
+    None (the default App) folds everything via the fast paths."""
+    if kind == "raw":
+        raw, ends, keys = parts[0], parts[1], parts[2]
+        mask = host_mask(keys)
+        if mask is None:
+            dictionary.add_scanned_raw(raw, ends, keys)
+            return
+        idx = np.nonzero(mask)[0].tolist()
+        if idx:
+            dictionary.add_scanned(_slice_words(raw, ends, idx), keys[idx])
+    else:
+        words, keys = parts[0], parts[1]
+        mask = host_mask(keys)
+        if mask is not None:
+            idx = np.nonzero(mask)[0].tolist()
+            if not idx:
+                return
+            words = [words[i] for i in idx]
+            keys = keys[idx]
+        dictionary.add_scanned(words, keys)
+
+
 _SENTINEL = object()
 
 
@@ -289,7 +324,8 @@ class _IngestStream:
     def __init__(self, cfg: Config, inputs: Sequence[str], stats: JobStats,
                  dictionary: Dictionary, doc_id_offset: int = 0,
                  skip_chunks: int = 0,
-                 doc_ids: "Sequence[int] | None" = None) -> None:
+                 doc_ids: "Sequence[int] | None" = None,
+                 host_mask=None) -> None:
         import queue
         import threading
         from concurrent.futures import ThreadPoolExecutor
@@ -301,6 +337,9 @@ class _IngestStream:
         # yielded — their words and counts are already in the checkpoint.
         self.skip_chunks = skip_chunks
         self.dictionary = dictionary
+        # Filtering apps (App.host_mask) restrict dictionary growth to
+        # their query keys; the default keep-all mask folds via fast paths.
+        self.host_mask = host_mask if host_mask is not None else (lambda keys: None)
         self.workers = max(cfg.ingest_threads, 1)
         self.pool = ThreadPoolExecutor(max_workers=self.workers)
         self.scans: collections.deque = collections.deque()
@@ -343,10 +382,7 @@ class _IngestStream:
     def _fold_done(self, block: bool = False) -> None:
         while self.scans and (block or self.scans[0].done()):
             kind, *rest = self.scans.popleft().result()
-            if kind == "raw":
-                self.dictionary.add_scanned_raw(*rest)
-            else:
-                self.dictionary.add_scanned(*rest)
+            fold_scan_into_dictionary(self.dictionary, self.host_mask, kind, rest)
             block = False  # blocking drain pops exactly one
 
     def __iter__(self):
@@ -439,7 +475,8 @@ def _stream_single(cfg: Config, app: App, inputs, stats, acc, dictionary,
             if int(ovf_n) > 0:
                 replay_chunk(chunk_host, did)
 
-    ingest = _IngestStream(cfg, inputs, stats, dictionary, doc_id_offset)
+    ingest = _IngestStream(cfg, inputs, stats, dictionary, doc_id_offset,
+                           host_mask=app.host_mask)
     try:
         for chunk in ingest:
             chunk_dev = jax.device_put(chunk.data, device)
@@ -624,10 +661,15 @@ def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
         stats.chunks += 1
         if kind == "raw":
             raw, ends, keys, counts = res
-            dictionary.add_scanned_raw(raw, ends, keys)
+            fold_scan_into_dictionary(dictionary, app.host_mask, "raw",
+                                      (raw, ends, keys))
         else:
             words, keys, counts = res
-            dictionary.add_scanned(words, keys)
+            fold_scan_into_dictionary(dictionary, app.host_mask, "list",
+                                      (words, keys))
+        mask = app.host_mask(keys)
+        if mask is not None:  # filtering app (e.g. grep): keep query keys only
+            keys, counts = keys[mask], counts[mask]
         values = app.host_values(counts, doc_id_offset + doc_id)
         # Fixed update capacity, splitting big windows across merges: ONE
         # compiled merge shape for the whole run (a variable cap means a
@@ -801,7 +843,7 @@ def _stream_multihost(cfg: Config, app: App, inputs, stats, acc, dictionary) -> 
     my_inputs = [(i, p) for i, p in enumerate(inputs) if i % nproc == pid]
     ingest = _IngestStream(
         cfg, [p for _i, p in my_inputs], stats, dictionary,
-        doc_ids=[i for i, _p in my_inputs],
+        doc_ids=[i for i, _p in my_inputs], host_mask=app.host_mask,
     )
 
     def to_global(local_np: np.ndarray, global_shape):
@@ -1045,7 +1087,8 @@ def _stream_sharded(cfg: Config, app: App, inputs, stats, acc, dictionary) -> No
         norm = normalize_native(raw)
         if norm is None:
             norm = normalize_unicode(raw)
-        dictionary.add_text(norm)
+        kind, *scan = _scan_payload(norm)
+        fold_scan_into_dictionary(dictionary, app.host_mask, kind, scan)
         # Group seams are host-side cuts like window seams, so they align
         # to whitespace — a token split THERE would fragment into keys no
         # dictionary entry matches. The arbitrary (mid-word) cuts this
@@ -1207,7 +1250,8 @@ def _stream_mesh(cfg: Config, app: App, inputs, stats, acc, dictionary) -> None:
         elif len(pending) >= 2 * depth:
             drain(depth)
 
-    ingest = _IngestStream(cfg, inputs, stats, dictionary, skip_chunks=skip_chunks)
+    ingest = _IngestStream(cfg, inputs, stats, dictionary, skip_chunks=skip_chunks,
+                           host_mask=app.host_mask)
     try:
         for chunk in ingest:
             group_chunks.append(chunk.data)

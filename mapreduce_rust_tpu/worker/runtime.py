@@ -96,11 +96,15 @@ class Worker:
             for chunk in chunk_stream(f, doc_id, self.cfg.chunk_bytes):
                 words = extract_words(bytes(chunk.data[: chunk.nbytes]))
                 counts.update(words)
-                dictionary.add_words(words)
         table: dict = {}
         uniq = list(counts.keys())
         keys = hash_words(uniq)
-        for w, (k1, k2) in zip(uniq, keys.tolist()):
+        mask = self.app.host_mask(keys) if len(uniq) else None
+        kept_words: list = []
+        for i, (w, (k1, k2)) in enumerate(zip(uniq, keys.tolist())):
+            if mask is not None and not mask[i]:
+                continue  # filtering app: not a query key (nor a dict entry)
+            kept_words.append(w)
             key = (k1, k2)
             if op == "sum":
                 table[key] = table.get(key, 0) + counts[w]
@@ -108,6 +112,7 @@ class Worker:
                 table.setdefault(key, set()).add(doc_id)
             else:  # max/min of count within the task — app-defined payloads
                 table[key] = counts[w]
+        dictionary.add_words(kept_words)
         return table, dictionary
 
     def _map_table_host_native(self, doc_id: int, path: str,
@@ -122,12 +127,18 @@ class Worker:
 
         op = self.app.combine_op
         table: dict = {}
+        from mapreduce_rust_tpu.runtime.driver import fold_scan_into_dictionary
+
         for _doc, window in _iter_windows(self.cfg, [path], JobStats()):
             res = scan_count_raw(window)
             if res is None:
                 return None
             raw, ends, keys, counts = res
-            dictionary.add_scanned_raw(raw, ends, keys)
+            fold_scan_into_dictionary(dictionary, self.app.host_mask, "raw",
+                                      (raw, ends, keys))
+            mask = self.app.host_mask(keys)
+            if mask is not None:  # filtering app: keep query keys only
+                keys, counts = keys[mask], counts[mask]
             if op == "sum":
                 for (k1, k2), c in zip(keys.tolist(), counts.tolist()):
                     key = (k1, k2)

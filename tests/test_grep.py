@@ -1,0 +1,101 @@
+"""Grep app: device-side exact-match filtering through every engine.
+
+Oracle: a query word's posting list is the sorted doc ids whose
+reference-semantics token set contains the normalized word; absent words
+produce no line at all (state holds only query keys)."""
+
+import collections
+import pathlib
+
+import pytest
+
+from mapreduce_rust_tpu.apps import get_app
+from mapreduce_rust_tpu.apps.grep import Grep
+from mapreduce_rust_tpu.core.normalize import reference_word_counts
+from mapreduce_rust_tpu.runtime.driver import run_job
+
+from test_driver import SMALL_TEXT, small_cfg, write_inputs
+
+DOC0 = SMALL_TEXT
+DOC1 = "the zebra grazes; a zebra runs. don’t stop\n" * 30
+DOC2 = "completely disjoint vocabulary over here\n" * 20
+
+
+def grep_oracle(texts, query):
+    """word(bytes) → sorted doc ids, for query words present anywhere."""
+    per_doc = []
+    for t in texts:
+        raw = t if isinstance(t, bytes) else t.encode()
+        per_doc.append({
+            (w.encode() if isinstance(w, str) else w)
+            for w in reference_word_counts(raw)
+        })
+    out = collections.defaultdict(list)
+    for q in query:
+        qb = q.encode()
+        for d, words in enumerate(per_doc):
+            if qb in words:
+                out[qb].append(d)
+    return dict(out)
+
+
+@pytest.mark.parametrize("engine", ["device", "host"])
+def test_grep_matches_oracle_both_engines(tmp_path, engine):
+    texts = [DOC0, DOC1, DOC2]
+    paths = write_inputs(tmp_path, texts)
+    query = ("zebra", "wife", "dont", "absentword")
+    app = Grep(query=query)
+    res = run_job(small_cfg(tmp_path, map_engine=engine), paths, app=app)
+    assert res.table == grep_oracle(texts, query)
+    # Only query keys ever reach state/egress — no corpus-wide leakage —
+    # and the egress dictionary scales with the QUERY, not the vocabulary.
+    assert set(res.table) <= {q.encode() for q in query}
+    assert res.stats.dictionary_words <= len(query)
+    assert res.stats.unknown_keys == 0
+
+
+@pytest.mark.parametrize("mesh", [2, 4])
+def test_grep_on_mesh(tmp_path, mesh):
+    texts = [DOC0, DOC1]
+    paths = write_inputs(tmp_path, texts)
+    query = ("zebra", "truth")
+    app = Grep(query=query)
+    res = run_job(small_cfg(tmp_path, mesh_shape=mesh), paths, app=app)
+    assert res.table == grep_oracle(texts, query)
+    assert res.stats.dictionary_words <= len(query)
+
+
+def test_grep_query_normalized_like_corpus(tmp_path):
+    # "don't" must match the corpus token "dont" (punctuation deleted),
+    # exactly as the reference's regex strip produces it (src/app/wc.rs:7).
+    texts = [DOC1]
+    paths = write_inputs(tmp_path, texts)
+    res = run_job(small_cfg(tmp_path), paths, app=Grep(query=("don't",)))
+    assert res.table == {b"dont": [0]}
+
+
+def test_grep_output_lines(tmp_path):
+    paths = write_inputs(tmp_path, [DOC0, DOC1])
+    res = run_job(small_cfg(tmp_path), paths, app=Grep(query=("the",)))
+    lines = []
+    for p in res.output_files:
+        lines += pathlib.Path(p).read_bytes().splitlines()
+    assert lines == [b"the 0,1"]
+
+
+def test_grep_bad_queries_fail_loudly():
+    import numpy as np
+
+    some_keys = np.zeros((1, 2), dtype=np.uint32)
+    with pytest.raises(ValueError):  # empty query
+        Grep(query=()).host_mask(some_keys)
+    with pytest.raises(ValueError):  # splits into two tokens
+        Grep(query=("two words",)).host_mask(some_keys)
+    with pytest.raises(ValueError):  # normalizes to nothing
+        Grep(query=("...",)).host_mask(some_keys)
+
+
+def test_grep_via_registry():
+    app = get_app("grep", query=("abc",))
+    assert app.combine_op == "distinct"
+    assert app.query == ("abc",)
