@@ -340,10 +340,11 @@ def main() -> None:
             errors.append(f"fallback corpus: {e!r}")
             try:
                 small = build_corpus(8)
-            except Exception:
+            except Exception as e2:
                 # Not even 8 MB fits: reuse whatever the main leg had. This
                 # may exceed the leg's time budget if it is the full-size
                 # corpus, but it is the only measurable byte stream left.
+                errors.append(f"fallback corpus (8MB): {e2!r}")
                 small = corpus
         dev, err = _run_device_leg(
             small, FALLBACK_TIMEOUT_S, _cpu_env(), init_timeout_s=PROBE_TIMEOUT_S

@@ -283,16 +283,22 @@ def _slice_words(raw: bytes, ends: np.ndarray, idx) -> list[bytes]:
     return [raw[(ends_l[i - 1] if i else 0): ends_l[i]] for i in idx]
 
 
-def fold_scan_into_dictionary(dictionary: Dictionary, host_mask, kind, parts) -> None:
+def scan_keys(kind, parts) -> np.ndarray:
+    """The hash-pair array of a tagged scan result."""
+    return parts[2] if kind == "raw" else parts[1]
+
+
+def fold_scan_into_dictionary(dictionary: Dictionary, mask, kind, parts) -> None:
     """Fold one tagged scan result — ("raw", raw, ends, keys[, ...]) or
     ("list", words, keys[, ...]) — into the egress dictionary, restricted
-    to the keys a filtering app keeps (App.host_mask). For grep-style apps
-    the dictionary then scales with the QUERY, not the corpus vocabulary —
-    non-query words are never materialized or inserted. host_mask returning
-    None (the default App) folds everything via the fast paths."""
+    to the keys a filtering app keeps. mask is the PRECOMPUTED
+    App.host_mask(scan_keys(...)) result (callers that also filter their
+    merge stream reuse it — the [n, Q] compare is per-window hot-path
+    work), or None for keep-everything, which folds via the fast paths.
+    For grep-style apps the dictionary then scales with the QUERY, not the
+    corpus vocabulary — non-query words are never materialized."""
     if kind == "raw":
         raw, ends, keys = parts[0], parts[1], parts[2]
-        mask = host_mask(keys)
         if mask is None:
             dictionary.add_scanned_raw(raw, ends, keys)
             return
@@ -301,7 +307,6 @@ def fold_scan_into_dictionary(dictionary: Dictionary, host_mask, kind, parts) ->
             dictionary.add_scanned(_slice_words(raw, ends, idx), keys[idx])
     else:
         words, keys = parts[0], parts[1]
-        mask = host_mask(keys)
         if mask is not None:
             idx = np.nonzero(mask)[0].tolist()
             if not idx:
@@ -382,7 +387,8 @@ class _IngestStream:
     def _fold_done(self, block: bool = False) -> None:
         while self.scans and (block or self.scans[0].done()):
             kind, *rest = self.scans.popleft().result()
-            fold_scan_into_dictionary(self.dictionary, self.host_mask, kind, rest)
+            mask = self.host_mask(scan_keys(kind, rest))
+            fold_scan_into_dictionary(self.dictionary, mask, kind, rest)
             block = False  # blocking drain pops exactly one
 
     def __iter__(self):
@@ -661,13 +667,12 @@ def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
         stats.chunks += 1
         if kind == "raw":
             raw, ends, keys, counts = res
-            fold_scan_into_dictionary(dictionary, app.host_mask, "raw",
-                                      (raw, ends, keys))
+            mask = app.host_mask(keys)
+            fold_scan_into_dictionary(dictionary, mask, "raw", (raw, ends, keys))
         else:
             words, keys, counts = res
-            fold_scan_into_dictionary(dictionary, app.host_mask, "list",
-                                      (words, keys))
-        mask = app.host_mask(keys)
+            mask = app.host_mask(keys)
+            fold_scan_into_dictionary(dictionary, mask, "list", (words, keys))
         if mask is not None:  # filtering app (e.g. grep): keep query keys only
             keys, counts = keys[mask], counts[mask]
         values = app.host_values(counts, doc_id_offset + doc_id)
@@ -1088,7 +1093,9 @@ def _stream_sharded(cfg: Config, app: App, inputs, stats, acc, dictionary) -> No
         if norm is None:
             norm = normalize_unicode(raw)
         kind, *scan = _scan_payload(norm)
-        fold_scan_into_dictionary(dictionary, app.host_mask, kind, scan)
+        fold_scan_into_dictionary(
+            dictionary, app.host_mask(scan_keys(kind, scan)), kind, scan
+        )
         # Group seams are host-side cuts like window seams, so they align
         # to whitespace — a token split THERE would fragment into keys no
         # dictionary entry matches. The arbitrary (mid-word) cuts this

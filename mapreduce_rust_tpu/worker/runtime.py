@@ -134,9 +134,8 @@ class Worker:
             if res is None:
                 return None
             raw, ends, keys, counts = res
-            fold_scan_into_dictionary(dictionary, self.app.host_mask, "raw",
-                                      (raw, ends, keys))
             mask = self.app.host_mask(keys)
+            fold_scan_into_dictionary(dictionary, mask, "raw", (raw, ends, keys))
             if mask is not None:  # filtering app: keep query keys only
                 keys, counts = keys[mask], counts[mask]
             if op == "sum":

@@ -65,6 +65,18 @@ def test_grep_on_mesh(tmp_path, mesh):
     assert res.stats.dictionary_words <= len(query)
 
 
+def test_grep_sharded_stream(tmp_path):
+    # Sequence-parallel ingestion: mid-word shard cuts repaired by the
+    # halo must not create or destroy query matches.
+    texts = ["interdependence " * 300 + "zebra quagga ", "quagga only here " * 50]
+    paths = write_inputs(tmp_path, texts)
+    query = ("zebra", "quagga", "interdependence")
+    cfg = small_cfg(tmp_path, mesh_shape=4, sharded_stream=True, chunk_bytes=2048)
+    res = run_job(cfg, paths, app=Grep(query=query), write_outputs=False)
+    assert res.table == grep_oracle(texts, query)
+    assert res.stats.dictionary_words <= len(query)
+
+
 def test_grep_query_normalized_like_corpus(tmp_path):
     # "don't" must match the corpus token "dont" (punctuation deleted),
     # exactly as the reference's regex strip produces it (src/app/wc.rs:7).

@@ -36,23 +36,24 @@ _WORKER = textwrap.dedent(
     import glob
     from mapreduce_rust_tpu.config import Config
     from mapreduce_rust_tpu.runtime.driver import run_job
+    app = None
+    if len(sys.argv) > 4 and sys.argv[4] == "grep":
+        from mapreduce_rust_tpu.apps.grep import Grep
+        app = Grep(query=tuple(sys.argv[5].split(",")))
     inputs = sorted(glob.glob(os.path.join(base, "in", "*.txt")))
     cfg = Config(chunk_bytes=4096, merge_capacity=1 << 14, reduce_n=3,
                  mesh_shape=jax.device_count(), device="cpu",
                  work_dir=os.path.join(base, "work"),
                  output_dir=os.path.join(base, "out"))
-    res = run_job(cfg, inputs)
+    res = run_job(cfg, inputs, app=app)
     print(f"OK proc={pid} local_table={len(res.table)} files={len(res.output_files)}")
     """
 )
 
 
-def test_two_process_end_to_end_run_job(tmp_path):
-    texts = [
-        "the quick brown fox jumps over the lazy dog " * 120,
-        "pack my box with five dozen liquor jugs " * 150,
-        "sphinx of black quartz judge my vow " * 180,
-    ]
+def _run_two_processes(tmp_path, texts, extra_args=()):
+    """Launch the 2-process job; returns merged 'word value' line dict, or
+    skips if jax.distributed cannot federate CPU backends here."""
     (tmp_path / "in").mkdir()
     for i, t in enumerate(texts):
         (tmp_path / "in" / f"doc-{i}.txt").write_text(t)
@@ -61,7 +62,8 @@ def test_two_process_end_to_end_run_job(tmp_path):
         port = str(s.getsockname()[1])
     procs = [
         subprocess.Popen(
-            [sys.executable, "-c", _WORKER, str(pid), port, str(tmp_path)],
+            [sys.executable, "-c", _WORKER, str(pid), port, str(tmp_path),
+             *extra_args],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             cwd=str(REPO_ROOT), env={**os.environ, "PYTHONPATH": str(REPO_ROOT)},
         )
@@ -82,16 +84,40 @@ def test_two_process_end_to_end_run_job(tmp_path):
     for rc, out, err in outs:
         assert rc == 0, (rc, out[-500:], err[-2000:])
         assert "OK proc=" in out
-
-    oracle = collections.Counter()
-    for t in texts:
-        oracle.update(reference_word_counts(t.encode()))
     got: dict = {}
     files = sorted((tmp_path / "out").glob("mr-*.txt"))
     assert len(files) == 6  # reduce_n=3 × 2 processes
     for f in files:
         for line in f.read_bytes().splitlines():
             w, v = line.rsplit(b" ", 1)
-            assert w.decode() not in got, f"key {w!r} emitted by two processes"
-            got[w.decode()] = int(v)
-    assert got == dict(oracle)
+            assert w not in got, f"key {w!r} emitted by two processes"
+            got[w] = v
+    return got
+
+
+def test_two_process_end_to_end_run_job(tmp_path):
+    texts = [
+        "the quick brown fox jumps over the lazy dog " * 120,
+        "pack my box with five dozen liquor jugs " * 150,
+        "sphinx of black quartz judge my vow " * 180,
+    ]
+    got = _run_two_processes(tmp_path, texts)
+    oracle = collections.Counter()
+    for t in texts:
+        oracle.update(reference_word_counts(t.encode()))
+    assert {w.decode(): int(v) for w, v in got.items()} == dict(oracle)
+
+
+def test_two_process_grep_cross_process_dictionary(tmp_path):
+    """Query words read by only ONE process must still print from whichever
+    process's chips own their hash class — the filtered dictionary exchange
+    over the shared work dir is what carries the word bytes across."""
+    texts = [
+        "the quick brown fox jumps over the lazy dog " * 120,  # doc 0 → proc 0
+        "pack my box with five dozen liquor jugs " * 150,      # doc 1 → proc 1
+        "sphinx of black quartz judge my vow " * 180,          # doc 2 → proc 0
+    ]
+    got = _run_two_processes(
+        tmp_path, texts, extra_args=("grep", "fox,jugs,sphinx,dog,absent")
+    )
+    assert got == {b"fox": b"0", b"jugs": b"1", b"sphinx": b"2", b"dog": b"0"}
