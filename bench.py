@@ -10,10 +10,15 @@ Structure (round-3 verdict: the old layout ran the fragile TPU leg first,
 unguarded, and lost the number three rounds running):
   1. corpus build (cheap, deterministic, cached in .bench/);
   2. CPU multi-process baseline FIRST — needs no JAX, cannot hang on a
-     wedged TPU plugin. Faithful reference-semantics per-task work (regex
-     strip + split + count; src/app/wc.rs:6-17) over whitespace-aligned
-     slices on a process pool like its map_n×worker_n model
-     (src/bin/mrworker.rs:43-151);
+     wedged TPU plugin. Faithful to the reference's ARCHITECTURE: map
+     tasks tokenize (regex strip + split, src/app/wc.rs:6-17) and
+     hash-partition every token occurrence into mr-{m}-{r}.txt files,
+     phase barrier, reduce tasks read them back and count — the
+     file-plane shuffle that defines the reference (src/mr/worker.rs:
+     117-140), on a process pool like its map_n×worker_n model
+     (src/bin/mrworker.rs:43-151). Batched file writes and a Counter
+     reduce are deliberate generosities (the original pays one awaited
+     write + one println per KV and a full sort per partition);
   3. device leg in a SUBPROCESS with a hard timeout — a crashed / wedged /
      version-skewed TPU runtime costs us the leg, not the JSON line;
   4. on device-leg failure, a bounded CPU-XLA fallback subprocess (smaller
@@ -42,7 +47,9 @@ BENCH_DIR = REPO / ".bench"
 TARGET_MB = int(os.environ.get("BENCH_TARGET_MB", "512"))  # big enough that
 # one-time costs (state fetch, finalize, egress) amortize into the rate,
 # small enough to stay page-cache-resident next to the CPU baseline run
-BASELINE_MB = int(os.environ.get("BENCH_BASELINE_MB", "32"))
+# 64 MB halves the baseline's run-to-run noise vs 32 MB (the 1-core pool
+# measurement swings ±50% at small sizes) at ~6 s per run.
+BASELINE_MB = int(os.environ.get("BENCH_BASELINE_MB", "64"))
 # Fallback is sized so fixed costs (state egress, 46K-key dictionary
 # finalize, jit dispatch) amortize: measured 0.017 GB/s at 8 MB,
 # 0.078 GB/s at 64 MB, 0.122 GB/s (exact, 13× baseline) at 1 GB for the
@@ -114,26 +121,82 @@ def _ws_aligned_slices(path: pathlib.Path, n: int, limit: int | None = None):
     return [(int(a), int(b)) for a, b in zip(bounds, bounds[1:])]
 
 
-def _count_slice(args) -> collections.Counter:
-    path, start, end = args
-    from mapreduce_rust_tpu.core.normalize import reference_word_counts
+def _map_task(args) -> int:
+    """One map task with the reference's ARCHITECTURE (src/mr/worker.rs:
+    142-155): read the slice, tokenize with reference semantics (regex
+    strip + split, src/app/wc.rs:6-13), then route EVERY occurrence by
+    hash(word) % reduce_n into per-(m, r) intermediate files — the
+    file-plane shuffle that defines the reference (worker.rs:117-140).
+    Deliberately GENEROUS vs the original: each partition file is written
+    in one call instead of one awaited write + one println per KV pair
+    (worker.rs:131-136)."""
+    import re
 
+    import zlib
+
+    path, start, end, m, reduce_n, workdir = args
     with open(path, "rb") as f:
         f.seek(start)
-        return reference_word_counts(f.read(end - start))
+        text = f.read(end - start).decode("utf-8", errors="replace")
+    toks = re.sub(r"[^\w\s]", "", text, flags=re.UNICODE).split()
+    bufs: list[list] = [[] for _ in range(reduce_n)]
+    # Deterministic hash (builtin hash() is seed-randomized per process —
+    # under a spawn start method each worker would route the same word to
+    # a DIFFERENT partition and silently break the grouping invariant).
+    for w in toks:  # per-KV hash + route, like worker.rs:127-137
+        bufs[zlib.crc32(w.encode()) % reduce_n].append(w)
+    for r, b in enumerate(bufs):
+        with open(os.path.join(workdir, f"mr-{m}-{r}.txt"), "w",
+                  encoding="utf-8") as f:
+            if b:
+                f.write(" 1\n".join(b))
+                f.write(" 1\n")
+    return len(toks)
 
 
-def cpu_baseline_gbs(path: pathlib.Path, limit_bytes: int, workers: int = 8) -> float:
-    """Multi-process reference-semantics word count, GB/s."""
+def _reduce_task(args) -> collections.Counter:
+    """One reduce task (worker.rs:157-193): read every map's partition-r
+    file, parse the 'word 1' lines, group-count. Counter replaces the
+    reference's full lexicographic sort + linear group scan
+    (worker.rs:162-184) — again the generous choice."""
+    r, map_n, workdir = args
+    c: collections.Counter = collections.Counter()
+    for m in range(map_n):
+        with open(os.path.join(workdir, f"mr-{m}-{r}.txt"),
+                  encoding="utf-8") as f:
+            c.update(s[:-2] for s in f.read().splitlines())
+    return c
+
+
+def cpu_baseline_gbs(path: pathlib.Path, limit_bytes: int, workers: int = 8,
+                     reduce_n: int = 4) -> float:
+    """Multi-process reference-ARCHITECTURE word count, GB/s: map tasks
+    hash-partition every token into mr-{m}-{r}.txt files, a phase barrier,
+    then reduce tasks read them back and count — the reference's exact
+    data movement (control via the pool, data via the filesystem), with
+    batched IO and Counter reduce as generous simplifications."""
+    import shutil
+
+    workdir = str(BENCH_DIR / "baseline-shuffle")
+    shutil.rmtree(workdir, ignore_errors=True)
+    os.makedirs(workdir)
     slices = _ws_aligned_slices(path, workers, limit_bytes)
     t0 = time.perf_counter()
     with multiprocessing.Pool(workers) as pool:
-        parts = pool.map(_count_slice, [(str(path), a, b) for a, b in slices])
-    total = collections.Counter()
-    for c in parts:
-        total.update(c)
+        n_tok = pool.map(
+            _map_task,
+            [(str(path), a, b, m, reduce_n, workdir)
+             for m, (a, b) in enumerate(slices)],
+        )
+        # map→reduce phase barrier (the reference's get_reduce_task gate,
+        # src/mr/coordinator.rs:183-185) is implicit in the two pool.maps.
+        parts = pool.map(
+            _reduce_task, [(r, len(slices), workdir) for r in range(reduce_n)]
+        )
     dt = time.perf_counter() - t0
-    assert len(total) > 0
+    total = sum(len(c) for c in parts)
+    assert total > 0 and sum(n_tok) == sum(sum(c.values()) for c in parts)
+    shutil.rmtree(workdir, ignore_errors=True)
     return limit_bytes / dt / 1e9
 
 
@@ -311,26 +374,25 @@ def main() -> None:
     except Exception as e:
         errors.append(f"cpu_baseline: {e!r}")
 
-    # Median of three device runs — the SAME estimator as the CPU baseline
-    # (an asymmetric max-vs-median pairing would bias the ratio upward).
+    # Median of three runs — the SAME estimator as the CPU baseline (an
+    # asymmetric max-vs-median pairing would bias the ratio upward).
     # Repeats are skipped when the first run was slow (cold compiles /
     # sick machine): one number beats a harness-level timeout. The
     # heartbeat init deadline applies to every attempt: a backend that
     # wedges mid-bench (not just before it) still can't eat the leg.
-    t0 = time.perf_counter()
-    dev, err = _run_device_leg(
-        corpus, DEVICE_TIMEOUT_S, None, init_timeout_s=PROBE_TIMEOUT_S
-    )
-    first_wall = time.perf_counter() - t0
-    if dev is not None and first_wall < DEVICE_TIMEOUT_S / 3:
-        more = [dev]
+    def median_leg(c: pathlib.Path, timeout_s: int, env: dict | None):
+        t0 = time.perf_counter()
+        first, e = _run_device_leg(c, timeout_s, env, init_timeout_s=PROBE_TIMEOUT_S)
+        if first is None or time.perf_counter() - t0 >= timeout_s / 3:
+            return first, e
+        more = [first]
         for _ in range(2):
-            r, _e = _run_device_leg(
-                corpus, DEVICE_TIMEOUT_S, None, init_timeout_s=PROBE_TIMEOUT_S
-            )
+            r, _e = _run_device_leg(c, timeout_s, env, init_timeout_s=PROBE_TIMEOUT_S)
             if r is not None:
                 more.append(r)
-        dev = sorted(more, key=lambda r: r["gbs"])[len(more) // 2]
+        return sorted(more, key=lambda r: r["gbs"])[len(more) // 2], None
+
+    dev, err = median_leg(corpus, DEVICE_TIMEOUT_S, None)
     if dev is None:
         errors.append(err)
         fallback = True
@@ -346,9 +408,7 @@ def main() -> None:
                 # corpus, but it is the only measurable byte stream left.
                 errors.append(f"fallback corpus (8MB): {e2!r}")
                 small = corpus
-        dev, err = _run_device_leg(
-            small, FALLBACK_TIMEOUT_S, _cpu_env(), init_timeout_s=PROBE_TIMEOUT_S
-        )
+        dev, err = median_leg(small, FALLBACK_TIMEOUT_S, _cpu_env())
         if dev is None:
             errors.append(f"fallback: {err}")
 
