@@ -7,7 +7,7 @@ import pathlib
 import numpy as np
 import pytest
 
-from mapreduce_rust_tpu.core.normalize import normalize_unicode, reference_word_counts
+from mapreduce_rust_tpu.core.normalize import reference_word_counts
 from mapreduce_rust_tpu.runtime.chunker import Chunk, chunk_document, split_points
 
 CORPUS = pathlib.Path("/root/reference/src/data")

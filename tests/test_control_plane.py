@@ -10,7 +10,6 @@ import collections
 import pathlib
 import socket
 
-import pytest
 
 from mapreduce_rust_tpu.apps import InvertedIndex, TopK
 from mapreduce_rust_tpu.config import Config
@@ -19,7 +18,6 @@ from mapreduce_rust_tpu.coordinator.server import (
     NOT_READY,
     WAIT,
     Coordinator,
-    CoordinatorClient,
 )
 from mapreduce_rust_tpu.core.normalize import reference_word_counts
 from mapreduce_rust_tpu.worker.runtime import Worker

@@ -9,7 +9,6 @@ import pytest
 
 from mapreduce_rust_tpu.apps import InvertedIndex, WordCount
 from mapreduce_rust_tpu.config import Config
-from mapreduce_rust_tpu.core.kv import KVBatch
 from mapreduce_rust_tpu.core.normalize import reference_word_counts
 from mapreduce_rust_tpu.parallel.shuffle import make_mesh, make_shuffle_step_fns
 from mapreduce_rust_tpu.runtime.driver import run_job
