@@ -257,6 +257,7 @@ def device_leg(path: str) -> None:
         "device_wait_s": round(s.device_wait_s, 3),
         "bottleneck": s.bottleneck,
         "host_map_s": round(s.host_map_s, 3),
+        "host_glue_s": round(s.host_glue_s, 3),
         "map_engine": cfg.map_engine,
         "phases": {k: round(v, 3) for k, v in s.phase_seconds.items()},
         "platform": platform,

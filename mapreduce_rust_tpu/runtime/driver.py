@@ -663,6 +663,7 @@ def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
 
     def consume(result) -> None:
         nonlocal state
+        t_glue = time.perf_counter()
         doc_id, kind, res = result
         stats.chunks += 1
         if kind == "raw":
@@ -688,6 +689,9 @@ def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
             )
             state, evicted, ev_count = merge_packed(state, flat)
             pending.append((ev_count, evicted))
+        # Glue stops before drain: drain's blocking readback is already
+        # accounted in device_wait_s and must not be double-counted.
+        stats.host_glue_s += time.perf_counter() - t_glue
         if len(pending) >= 2 * depth:
             drain(depth)
 

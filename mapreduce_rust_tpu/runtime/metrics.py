@@ -40,6 +40,10 @@ class JobStats:
     ingest_wait_s: float = 0.0
     device_wait_s: float = 0.0
     host_map_s: float = 0.0       # CPU time in the host-map engine's scan
+    host_glue_s: float = 0.0      # host-map engine main-thread work between
+    # scans: dictionary fold + update pack + device_put + merge dispatch —
+    # on a 1-core host this steals directly from the scan thread, so the
+    # split names which of the two to optimize
 
     @property
     def gb_per_s(self) -> float:
@@ -51,6 +55,7 @@ class JobStats:
             "host-ingest": self.ingest_wait_s,
             "device": self.device_wait_s,
             "host-map": self.host_map_s,
+            "host-glue": self.host_glue_s,
         }
         name, val = max(parts.items(), key=lambda kv: kv[1])
         return name if val > 0 else "balanced"
@@ -75,5 +80,5 @@ class JobStats:
             f"replays={self.partial_overflow_replays}+{self.bucket_skew_replays}skew "
             f"collisions={self.hash_collisions} unknown={self.unknown_keys} "
             f"waits[ingest={self.ingest_wait_s:.2f}s device={self.device_wait_s:.2f}s "
-            f"→ {self.bottleneck}] [{phases}]"
+            f"glue={self.host_glue_s:.2f}s → {self.bottleneck}] [{phases}]"
         )
