@@ -379,3 +379,42 @@ def test_sharded_stream_capacity_fault_replays_exact(tmp_path):
     res = run_job(cfg, paths, write_outputs=False)
     assert res.stats.partial_overflow_replays + res.stats.bucket_skew_replays > 0
     assert res.table == oracle_counts([text])
+
+
+@pytest.mark.parametrize("engine", ["device", "host"])
+def test_fuzz_unicode_end_to_end(tmp_path, engine):
+    """Adversarial end-to-end fuzz: random mixtures of ASCII, punctuation,
+    multi-byte letters, exotic whitespace, combining-free accents, invalid
+    UTF-8 and huge tokens, streamed through the full driver (tiny chunks,
+    tiny merge capacity → spills and replays) must equal the oracle on
+    BOTH engines. Deterministic seed — a failure reproduces exactly."""
+    import random
+
+    rng = random.Random(0xC0FFEE)
+    alphabet = (
+        [chr(c) for c in range(0x21, 0x7F)]          # ASCII incl. punctuation
+        + list("αβγδжшü信息🙂  　")       # letters + unicode spaces
+        + [" ", "\t", "\n", "…", "—", "“", "”", "'"]
+    )
+    docs = []
+    for _ in range(3):
+        pieces = []
+        for _ in range(4000):
+            r = rng.random()
+            if r < 0.9:
+                pieces.append(rng.choice(alphabet))
+            elif r < 0.95:
+                pieces.append(" " + "x" * rng.randrange(1, 40) + " ")
+            else:
+                pieces.append(rng.choice(["\ud800", ""]))  # lone surrogate
+        raw = "".join(pieces).encode("utf-8", errors="surrogatepass")
+        if rng.random() < 0.5:
+            raw += b"\xff\x80\xc2"  # invalid UTF-8 tail
+        docs.append(raw)
+    paths = write_inputs(tmp_path, docs)
+    cfg = small_cfg(tmp_path, chunk_bytes=1024, merge_capacity=1 << 10,
+                    partial_capacity=128, map_engine=engine,
+                    host_window_bytes=4096)
+    res = run_job(cfg, paths, write_outputs=False)
+    assert res.table == oracle_counts(docs)
+    assert res.stats.unknown_keys == 0
