@@ -45,6 +45,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from mapreduce_rust_tpu.apps.base import App
 from mapreduce_rust_tpu.core.kv import KVBatch
 from mapreduce_rust_tpu.ops.groupby import (
+    clamp_batch,
     compact_front,
     compaction_cap,
     count_unique,
@@ -131,7 +132,9 @@ def _chip_shuffle_tail(kv: KVBatch, doc_id, app: App, u_cap: int,
     local = count_unique(flat, op=op)  # distinct keys of MY hash class
     p_tot = jax.lax.psum(p_ovf, AXIS)
     b_tot = jax.lax.psum(b_ovf, AXIS)
-    local = local._replace(valid=local.valid & ((p_tot + b_tot) == 0))
+    # Clamp keys too, not just validity: the state shard stays sorted only
+    # if clamped records become SENTINEL padding (ops/groupby.clamp_batch).
+    local = clamp_batch(local, (p_tot + b_tot) == 0)
     if replicate_flags:
         return local, p_tot, b_tot
     return local, p_ovf, b_ovf
@@ -225,7 +228,9 @@ def _build_shuffle_step_fns(app: App, u_cap: int, bucket_cap: int, mesh: Mesh,
     def merge(state: KVBatch, local: KVBatch):
         st = KVBatch(*(x[0] for x in state))
         lc = KVBatch(*(x[0] for x in local))
-        new_state, evicted = merge_batches(st, lc, op=op)
+        # local is a count_unique output — key-sorted — so the rank-merge
+        # inserts it into the (always-sorted) state shard without a sort.
+        new_state, evicted = merge_batches(st, lc, op=op, update_sorted=True)
         ev_count = jnp.sum(evicted.valid.astype(jnp.int32))
         return (
             KVBatch(*(x[None] for x in new_state)),

@@ -59,6 +59,7 @@ from mapreduce_rust_tpu.apps.word_count import WordCount
 from mapreduce_rust_tpu.config import Config
 from mapreduce_rust_tpu.core.kv import KVBatch
 from mapreduce_rust_tpu.ops.groupby import (
+    clamp_batch,
     compact_front,
     compaction_cap,
     count_unique,
@@ -170,17 +171,20 @@ def _build_step_fns(app: App, u_cap: int, use_pallas: bool = False):
         partial = count_unique(kv, op=op)
         update = partial.take_front(u_cap)
         ovf = jnp.sum(partial.valid[u_cap:].astype(jnp.int32)) + c_ovf
-        # An overflowing chunk contributes NOTHING (update clamps to empty):
-        # the driver replays it full-width later. This makes the merge safe
-        # to dispatch before the overflow flag ever reaches the host, which
-        # is what lets the stream loop batch its readbacks (one device→host
-        # round trip per pipeline window, not per chunk).
-        update = update._replace(valid=update.valid & (ovf == 0))
+        # An overflowing chunk contributes NOTHING (update clamps to empty,
+        # keys included — ops/groupby.clamp_batch keeps the merged state
+        # sorted): the driver replays it full-width later. This makes the
+        # merge safe to dispatch before the overflow flag ever reaches the
+        # host, which is what lets the stream loop batch its readbacks (one
+        # device→host round trip per pipeline window, not per chunk).
+        update = clamp_batch(update, ovf == 0)
         return update, ovf
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def merge(state: KVBatch, update: KVBatch):
-        new_state, evicted = merge_batches(state, update, op=op)
+        # update is a count_unique output — already key-sorted, so the
+        # rank-merge inserts it without any sort at all.
+        new_state, evicted = merge_batches(state, update, op=op, update_sorted=True)
         ev_count = jnp.sum(evicted.valid.astype(jnp.int32))
         return new_state, evicted, ev_count
 
@@ -733,8 +737,11 @@ def _job_fingerprint(cfg: Config, app: App, inputs, d: int) -> str:
     for p in inputs:
         st = os.stat(p)
         h.update(f"{p}:{st.st_size}:{st.st_mtime_ns};".encode())
+    # state-v2: merge_batches now REQUIRES a sorted state (rank-merge); a
+    # checkpoint from the validity-only-clamp era can hold mid-array
+    # SENTINEL holes, which would silently mis-merge — reject it.
     h.update(
-        f"{app.name}:{app.combine_op}:{cfg.chunk_bytes}:{d}:"
+        f"state-v2:{app.name}:{app.combine_op}:{cfg.chunk_bytes}:{d}:"
         f"{cfg.effective_partial_capacity()}:{cfg.merge_capacity}".encode()
     )
     return h.hexdigest()

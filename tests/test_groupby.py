@@ -143,6 +143,62 @@ def test_distinct_op_merges_associatively():
     assert got == [((1, 1), 3), ((1, 1), 4), ((2, 2), 5)]
 
 
+def test_merge_sorted_runs_equals_sort():
+    # The rank-merge must produce exactly the sorted interleave lax.sort
+    # would: same multiset, globally key-sorted, padding at the back.
+    from mapreduce_rust_tpu.ops.groupby import merge_sorted_runs
+
+    rng = np.random.default_rng(11)
+    for na, va, nb, vb in [(64, 40, 16, 9), (16, 3, 64, 50), (32, 0, 8, 5)]:
+        ka = np.sort(rng.choice(1 << 16, size=va, replace=False)).astype(np.uint32)
+        kb = np.sort(rng.choice(1 << 16, size=vb, replace=False)).astype(np.uint32)
+        a = make_batch(np.stack([ka, ka], 1).reshape(-1, 2), np.arange(va), na)
+        b = make_batch(np.stack([kb, kb], 1).reshape(-1, 2), 100 + np.arange(vb), nb)
+        out = merge_sorted_runs(a, b)
+        assert out.capacity == na + nb
+        k1 = np.asarray(out.k1)
+        valid = np.asarray(out.valid)
+        # Globally sorted (SENTINEL padding included) and nothing lost.
+        assert (k1[:-1] <= k1[1:]).all()
+        got = sorted(zip(k1[valid].tolist(), np.asarray(out.value)[valid].tolist()))
+        want = sorted(
+            list(zip(ka.tolist(), range(va))) + list(zip(kb.tolist(), range(100, 100 + vb)))
+        )
+        assert got == want
+
+
+def test_merge_after_clamped_update_stays_sorted_and_exact():
+    # Regression for the rank-merge sortedness contract: a clamped
+    # (overflow) update must leave the state SORTED — clamp_batch turns its
+    # keys into SENTINEL padding, not mid-array holes — so later merges
+    # stay exact.
+    from mapreduce_rust_tpu.ops.groupby import clamp_batch
+
+    state = KVBatch.empty(8)
+    upd1 = count_unique(make_batch([(2, 2), (9, 9), (5, 5)], [1, 1, 1], 8))
+    state, _ = merge_batches(state, upd1, update_sorted=True)
+    # Simulate the driver's overflow clamp: real sorted keys, all invalid.
+    upd2 = clamp_batch(
+        count_unique(make_batch([(1, 1), (7, 7)], [1, 1], 8)), jnp.bool_(False)
+    )
+    state, _ = merge_batches(state, upd2, update_sorted=True)
+    k1 = np.asarray(state.k1)
+    assert (k1[:-1] <= k1[1:]).all(), "state must stay sorted after a clamp"
+    upd3 = count_unique(make_batch([(5, 5), (1, 1)], [1, 1], 8))
+    state, _ = merge_batches(state, upd3, update_sorted=True)
+    assert batch_to_dict(state) == {(2, 2): 1, (9, 9): 1, (5, 5): 2, (1, 1): 1}
+
+
+def test_merge_update_larger_than_state():
+    # Replay tiers can pass an update WIDER than the state (full-width
+    # u_cap > merge_capacity): rank-merge must handle na < nb.
+    state = make_batch([(1, 1), (5, 5)], [3, 4], capacity=2)
+    upd = make_batch([(0, 0), (1, 1), (6, 6), (7, 7), (9, 9)], [1] * 5, capacity=8)
+    new_state, evicted = merge_batches(state, upd)
+    combined = {**batch_to_dict(new_state), **batch_to_dict(evicted)}
+    assert combined == {(0, 0): 1, (1, 1): 4, (5, 5): 4, (6, 6): 1, (7, 7): 1, (9, 9): 1}
+
+
 def test_bucket_scatter_routes_by_k1_mod():
     nb, cap = 4, 8
     keys = [(k1, 7) for k1 in [0, 1, 2, 3, 4, 5, 8, 9]]
