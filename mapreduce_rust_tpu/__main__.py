@@ -75,8 +75,14 @@ def _app(args):
         from mapreduce_rust_tpu.apps.grep import _query_keys
 
         query = tuple(w for w in args.query.split(",") if w)
-        _query_keys(query)  # validate NOW — a bad --query is a CLI error,
-        # not a mid-run traceback inside every worker's map task
+        try:
+            _query_keys(query)  # validate NOW — a bad --query is a CLI
+            # error, not a mid-run traceback inside every map task
+        except ValueError as e:
+            parser = getattr(args, "_parser", None)
+            if parser is not None:
+                parser.error(str(e))  # argparse-style usage exit (code 2)
+            raise
         return get_app(args.app, query=query)
     return get_app(args.app)
 
@@ -204,6 +210,7 @@ def main(argv: list[str] | None = None) -> int:
     _add_common(p)
 
     args = parser.parse_args(argv)
+    args._parser = parser  # lets _app turn validation failures into usage errors
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",

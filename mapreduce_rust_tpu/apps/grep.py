@@ -57,7 +57,12 @@ def _query_keys(query: tuple[str, ...]) -> np.ndarray:
                 f"({toks!r}); each query must be exactly one word"
             )
         words.append(toks[0])
-    return hash_words(words)
+    arr = hash_words(words)
+    # The cached array is shared by every caller (device_map, host_mask,
+    # CLI validation) — freeze it so a mutating caller fails loudly
+    # instead of silently corrupting all subsequent queries' filters.
+    arr.flags.writeable = False
+    return arr
 
 
 @dataclasses.dataclass(frozen=True)

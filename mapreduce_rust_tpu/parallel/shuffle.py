@@ -241,6 +241,19 @@ def _build_shuffle_step_fns(app: App, u_cap: int, bucket_cap: int, mesh: Mesh,
     return map_shuffle, merge
 
 
+#: Wire size of one KVBatch record through the all_to_all:
+#: k1 (4) + k2 (4) + value (4) + valid (1).
+RECORD_WIRE_BYTES = 13
+
+
+def wire_bytes_per_round(n_devices: int, bucket_cap: int) -> int:
+    """Bytes one all_to_all round moves across the mesh: every chip sends
+    D fixed-capacity buckets (static shapes under jit — padding crosses the
+    interconnect too, which is exactly why this number, not the live-record
+    count, is the ICI-attribution metric)."""
+    return n_devices * n_devices * bucket_cap * RECORD_WIRE_BYTES
+
+
 def default_bucket_cap(u_cap: int, n_devices: int, factor: float) -> int:
     """Per-(src,dst) bucket capacity: even split × slack factor, padded to
     the next multiple of 8 for TPU-friendly layouts."""

@@ -52,9 +52,25 @@ def extract_words(normalized: bytes) -> list[bytes]:
 
 
 class Dictionary:
-    """hash pair → word bytes, built incrementally at ingest."""
+    """hash pair → word bytes, built incrementally at ingest.
 
-    def __init__(self) -> None:
+    Bounded-memory tier (VERDICT r4 missing 3): with ``budget_words`` set,
+    the word store flushes to a SORTED run file on disk
+    (``spill_dir/dictrun-*.txt``, 'k1 k2 word' lines ordered by packed key)
+    whenever it crosses the budget, keeping only the packed-key/length
+    arrays (8+8 bytes per word) in RAM for dedup + collision probing. A
+    spilled dictionary no longer serves point ``lookup`` for flushed words
+    — egress must consume ``iter_sorted()`` (the streaming merge-join in
+    runtime/driver.run_job does). Equal-length pair collisions on flushed
+    words pass undetected, the same degradation add_scanned_raw documents.
+    """
+
+    def __init__(self, budget_words: int | None = None,
+                 spill_dir: str | None = None) -> None:
+        if budget_words is not None and not spill_dir:
+            raise ValueError("budget_words needs a spill_dir")
+        self.budget_words = budget_words
+        self.spill_dir = spill_dir
         self._word_of: dict[tuple[int, int], bytes] = {}
         self._seen: set[bytes] = set()
         # (k1<<32)|k2 (always non-negative Python int) → stored word length.
@@ -71,15 +87,65 @@ class Dictionary:
         self._sorted_lens = np.empty(0, dtype=np.int64)
         self._fresh_keys: list[int] = []
         self._fresh_lens: list[int] = []
+        self._runs: list[str] = []
+        self._total_words = 0  # RAM + flushed distinct words
 
     def __len__(self) -> int:
-        return len(self._word_of)
+        return self._total_words
 
     def __contains__(self, key: tuple[int, int]) -> bool:
         return key in self._word_of
 
+    @property
+    def spilled(self) -> bool:
+        return bool(self._runs)
+
     def lookup(self, k1: int, k2: int) -> bytes | None:
+        """Point lookup — RAM-resident words only. A spilled dictionary
+        (see class docstring) serves flushed words via iter_sorted()."""
         return self._word_of.get((k1, k2))
+
+    def _maybe_flush(self) -> None:
+        if self.budget_words is not None and len(self._word_of) >= self.budget_words:
+            self._flush_words()
+
+    def _flush_words(self) -> None:
+        """Spill the in-RAM word store as one sorted run file; keep only
+        the packed-key/length arrays for membership + collision probes."""
+        if not self._word_of:
+            return
+        self._merge_fresh()
+        os.makedirs(self.spill_dir, exist_ok=True)
+        path = os.path.join(
+            self.spill_dir, f"dictrun-{os.getpid()}-{len(self._runs)}.txt"
+        )
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            for (k1, k2), w in sorted(
+                self._word_of.items(), key=lambda it: (it[0][0] << 32) | it[0][1]
+            ):
+                f.write(b"%d %d %s\n" % (k1, k2, w))
+        os.replace(tmp, path)
+        self._runs.append(path)
+        self._word_of.clear()
+        self._seen.clear()
+        # Membership stays exact via _packed_sorted; the per-key dict would
+        # otherwise grow unbounded alongside the words it indexes.
+        self._len_of.clear()
+
+    def _stored_len(self, packed: int) -> "int | None":
+        """Stored word length for a packed key, or None if unseen — exact
+        membership across BOTH tiers (fresh dict + merged sorted arrays),
+        which is what keeps dedup correct after a flush clears the dicts."""
+        v = self._len_of.get(packed)
+        if v is not None:
+            return v
+        if len(self._packed_sorted):
+            p = np.uint64(packed)
+            i = int(np.searchsorted(self._packed_sorted, p))
+            if i < len(self._packed_sorted) and self._packed_sorted[i] == p:
+                return int(self._sorted_lens[i])
+        return None
 
     def _insert_hashed(self, words, keys) -> int:
         """Single insert/collision-detection path shared by the Python and
@@ -93,18 +159,23 @@ class Dictionary:
             seen.add(w)
             key = (k1, k2)
             packed = (k1 << 32) | k2
-            if packed not in self._len_of:
+            if self._stored_len(packed) is None:
                 self._len_of[packed] = len(w)
                 # Every insert path must feed the vectorized filter, or the
                 # key stays permanently "suspicious" to add_scanned_raw.
                 self._fresh_keys.append(packed)
                 self._fresh_lens.append(len(w))
-            prev = word_of.get(key)
-            if prev is None:
                 word_of[key] = w
                 added += 1
-            elif prev != w:
-                self.collisions.append((prev, w))
+                self._total_words += 1
+            else:
+                prev = word_of.get(key)
+                if prev is not None and prev != w:
+                    self.collisions.append((prev, w))
+                # prev None + stored len: the word was flushed to a run —
+                # dedup holds; an equal-pair different word here goes
+                # undetected (class-docstring degradation).
+        self._maybe_flush()
         return added
 
     def add_scanned_raw(self, raw: bytes, ends: np.ndarray, keys: np.ndarray) -> int:
@@ -144,7 +215,7 @@ class Dictionary:
                 prev_end = ends_l[i - 1] if i else 0
                 wlen = end - prev_end
                 p = packed_l[i]
-                stored = len_of.get(p)
+                stored = self._stored_len(p)
                 if stored is None:
                     w = raw[prev_end:end]
                     len_of[p] = wlen
@@ -153,6 +224,7 @@ class Dictionary:
                     if key not in word_of:
                         word_of[key] = w
                         added += 1
+                        self._total_words += 1
                     self._fresh_keys.append(p)
                     self._fresh_lens.append(wlen)
                 elif stored != wlen:
@@ -167,6 +239,7 @@ class Dictionary:
             # high-cardinality corpora.
             if len(self._fresh_keys) >= max(1024, len(self._packed_sorted) // 4):
                 self._merge_fresh()
+            self._maybe_flush()
         return added
 
     def _merge_fresh(self) -> None:
@@ -214,22 +287,52 @@ class Dictionary:
         return self.add_scanned_raw(*res)
 
     def items(self) -> Iterator[tuple[tuple[int, int], bytes]]:
+        """RAM-resident entries only — spilled runs are served by
+        iter_sorted()."""
         return iter(self._word_of.items())
 
+    def iter_sorted(self) -> Iterator[tuple[int, int, int, bytes]]:
+        """(packed, k1, k2, word) over the WHOLE dictionary — disk runs
+        plus the RAM tier — in ascending packed-key order. Tiers are
+        key-disjoint by construction (membership spans both), so this is a
+        plain k-way merge with no dedup. The streaming-egress join consumes
+        this against the accumulator's sorted fold (runtime/driver)."""
+        import heapq
+
+        def run_iter(path):
+            with open(path, "rb") as f:
+                for line in f:
+                    a, b, w = line.rstrip(b"\n").split(b" ", 2)
+                    k1, k2 = int(a), int(b)
+                    yield ((k1 << 32) | k2, k1, k2, w)
+
+        def ram_iter():
+            for (k1, k2), w in sorted(
+                self._word_of.items(), key=lambda it: (it[0][0] << 32) | it[0][1]
+            ):
+                yield ((k1 << 32) | k2, k1, k2, w)
+
+        its = [run_iter(p) for p in self._runs] + [ram_iter()]
+        return heapq.merge(*its, key=lambda t: t[0])
+
     def merge(self, other: "Dictionary") -> None:
+        if other.spilled:
+            raise ValueError("cannot merge a disk-spilled dictionary")
         self.collisions.extend(other.collisions)
         for key, w in other._word_of.items():
-            prev = self._word_of.get(key)
-            if prev is None:
+            packed = (key[0] << 32) | key[1]
+            if self._stored_len(packed) is None:
                 self._word_of[key] = w
                 self._seen.add(w)
-                packed = (key[0] << 32) | key[1]
-                if packed not in self._len_of:
-                    self._len_of[packed] = len(w)
-                    self._fresh_keys.append(packed)
-                    self._fresh_lens.append(len(w))
-            elif prev != w:
-                self.collisions.append((prev, w))
+                self._len_of[packed] = len(w)
+                self._fresh_keys.append(packed)
+                self._fresh_lens.append(len(w))
+                self._total_words += 1
+            else:
+                prev = self._word_of.get(key)
+                if prev is not None and prev != w:
+                    self.collisions.append((prev, w))
+        self._maybe_flush()
 
     # ---- persistence (the multi-process control-plane path: map tasks
     # write dictionary shards next to their spilled partials, reduce tasks
@@ -238,10 +341,16 @@ class Dictionary:
     def save(self, path: str | os.PathLike) -> None:
         """Words contain no whitespace bytes, so 'k1 k2 word' lines are safe;
         collision events persist as '! kept rejected' lines so shard merges
-        never lose collision accounting."""
+        never lose collision accounting. Disk runs stream through file to
+        file — a spilled dictionary saves without rehydrating into RAM."""
+        import shutil
+
         with open(path, "wb") as f:
             for kept, rejected in self.collisions:
                 f.write(b"! %s %s\n" % (kept, rejected))
+            for run in self._runs:
+                with open(run, "rb") as rf:
+                    shutil.copyfileobj(rf, f)
             for (k1, k2), w in self._word_of.items():
                 f.write(b"%d %d %s\n" % (k1, k2, w))
 
@@ -256,6 +365,8 @@ class Dictionary:
                     continue
                 a, b, w = line.rstrip(b"\n").split(b" ", 2)
                 k1, k2 = int(a), int(b)
+                if (k1, k2) not in d._word_of:
+                    d._total_words += 1
                 d._word_of[(k1, k2)] = w
                 d._seen.add(w)
                 d._len_of.setdefault((k1 << 32) | k2, len(w))

@@ -29,6 +29,12 @@ class JobStats:
     bucket_skew_replays: int = 0       # mesh groups re-run on the skew tier
     halo_truncations: int = 0     # sharded-stream tokens longer than the halo
                                   # (possibly truncated hash — exactness fault)
+    mesh_rounds: int = 0          # all_to_all rounds executed (incl. replays)
+    shuffle_wire_bytes: int = 0   # bytes through the all_to_all: the padded
+    # bucket payload every chip exchanges each round — D*D*bucket_cap
+    # records x 13 B (k1+k2+value+valid). This is what actually crosses the
+    # interconnect (buckets are fixed-capacity under jit), so mesh runs can
+    # attribute time to ICI vs compute before any multi-chip perf claim.
     dictionary_words: int = 0
     hash_collisions: int = 0
     unknown_keys: int = 0         # final keys missing from the dictionary
@@ -78,6 +84,7 @@ class JobStats:
             f"distinct={self.distinct_keys} dict={self.dictionary_words} "
             f"spills={self.spill_events}({self.spilled_keys} keys) "
             f"replays={self.partial_overflow_replays}+{self.bucket_skew_replays}skew "
+            f"shuffle[{self.mesh_rounds} rounds, {self.shuffle_wire_bytes / 1e6:.1f} MB wire] "
             f"collisions={self.hash_collisions} unknown={self.unknown_keys} "
             f"waits[ingest={self.ingest_wait_s:.2f}s device={self.device_wait_s:.2f}s "
             f"glue={self.host_glue_s:.2f}s → {self.bottleneck}] [{phases}]"

@@ -267,6 +267,10 @@ def test_mesh_top_k_device_selection_matches_oracle(tmp_path):
     paths = write_inputs(tmp_path, [text])
     cfg = small_cfg(tmp_path, mesh_shape=4, reduce_n=2)
     res = run_job(cfg, paths, app=TopK(k=3))
+    # Mesh runs must attribute interconnect traffic: every group is one
+    # all_to_all round of D*D*bucket_cap padded records (VERDICT r4 #6).
+    assert res.stats.mesh_rounds > 0
+    assert res.stats.shuffle_wire_bytes > 0
     # Device selection fetched only per-chip candidates (<= 4*3), not the
     # 100-word vocabulary...
     assert len(res.table) <= 12
