@@ -246,7 +246,8 @@ def micro_leg() -> None:
     u_cap = cfg.effective_partial_capacity()
     map_combine, merge = make_step_fns(WordCount(), u_cap, platform == "tpu")
 
-    seed = (REF_DATA / "gut-4.txt").read_bytes() if REF_DATA.exists() else b"a b c " * 200000
+    seed_file = REF_DATA / "gut-4.txt"
+    seed = seed_file.read_bytes() if seed_file.is_file() else b"a b c " * 200000
     chunk = np.frombuffer((seed * (cfg.chunk_bytes // len(seed) + 1))[: cfg.chunk_bytes], np.uint8)
 
     # h2d: one 64 MB transfer, timed end-to-end (tunnel round trip included).

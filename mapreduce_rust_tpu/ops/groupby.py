@@ -125,6 +125,93 @@ def merge_sorted_runs(a: KVBatch, b: KVBatch, by_value: bool = False) -> KVBatch
     )
 
 
+_OP_IDENTITY = {
+    "sum": 0,
+    "max": jnp.iinfo(jnp.int32).min,
+    "min": jnp.iinfo(jnp.int32).max,
+}
+
+
+def combine_adjacent_unique(merged: KVBatch, op: str = "sum") -> KVBatch:
+    """Reduce a sorted batch where every key appears AT MOST TWICE among
+    valid records, the two adjacent (merge_sorted_runs output when each
+    input side is key-distinct — true for every running-state merge: state
+    and update are both count_unique-style reduced).
+
+    Same output contract as segment_reduce_sorted — front-packed distinct
+    keys, SENTINEL fill — but via shifted compares plus ONE compaction
+    scatter instead of seven segment ops: the second-biggest cost of the
+    per-chunk merge after the (already removed) full sort.
+    """
+    if op not in REDUCE_OPS:
+        raise ValueError(f"unknown reduce op: {op}")
+    n = merged.capacity
+    k1, k2, val, valid = merged.k1, merged.k2, merged.value, merged.valid
+    by_value = op in _VALUE_KEYED_OPS
+    eq = (k1[:-1] == k1[1:]) & (k2[:-1] == k2[1:])
+    if by_value:
+        eq = eq & (val[:-1] == val[1:])
+    false1 = jnp.zeros((1,), bool)
+    eq_next = jnp.concatenate([eq, false1])   # i equals i+1
+    first = jnp.concatenate([~false1, ~eq])   # run head mask
+    nxt_valid = jnp.concatenate([valid[1:], false1])
+    ident = jnp.int32(_OP_IDENTITY.get(op, 0))
+    if by_value:
+        # Value is part of the key: every run member shares it.
+        pairv = val
+    else:
+        v = jnp.where(valid, val, ident)
+        nxt_v = jnp.concatenate([v[1:], jnp.full((1,), ident, v.dtype)])
+        other = jnp.where(eq_next, nxt_v, ident)
+        if op == "sum":
+            pairv = v + other
+        elif op == "max":
+            pairv = jnp.maximum(v, other)
+        else:
+            pairv = jnp.minimum(v, other)
+    # A run is live iff its head or the head's twin is valid; deeper run
+    # members (equal-key padding chains) are invalid by the merge order.
+    live = first & (valid | (eq_next & nxt_valid))
+    # The ONE run that can mix valid records with padding is the
+    # (SENTINEL, SENTINEL) tail: the invalid⇒SENTINEL-key invariant makes
+    # every real-keyed run all-valid (≤1 member per side), but a real word
+    # hashing to the sentinel pair lands INSIDE the padding run, possibly
+    # not adjacent to its cross-side twin. Fix that run directly with one
+    # masked reduction — cheaper than ordering validity into the merge.
+    is_sent = (k1 == jnp.uint32(SENTINEL)) & (k2 == jnp.uint32(SENTINEL))
+    if by_value:
+        # Value joins the key, so only the padding-valued (0) sentinel run
+        # can contain padding; a live valid member keeps it alive.
+        sent0 = is_sent & (val == 0)
+        head = first & sent0
+        live = live | (head & jnp.any(valid & sent0))
+    else:
+        sent_vals = jnp.where(valid & is_sent, val, ident)
+        if op == "sum":
+            sent_total = jnp.sum(sent_vals)
+        elif op == "max":
+            sent_total = jnp.max(sent_vals)
+        else:
+            sent_total = jnp.min(sent_vals)
+        head = first & is_sent
+        live = live | (head & jnp.any(valid & is_sent))
+        pairv = jnp.where(head, sent_total, pairv)
+    # Compact run heads to the front, in order; the rest hit the dump slot.
+    dest = jnp.where(first, jnp.cumsum(first.astype(jnp.int32)) - 1, n)
+    sent = jnp.uint32(SENTINEL)
+
+    def place(x, fill):
+        buf = jnp.full((n + 1,), fill, x.dtype)
+        return buf.at[dest].set(x, mode="drop")[:n]
+
+    return KVBatch(
+        k1=place(jnp.where(live, k1, sent), sent),
+        k2=place(jnp.where(live, k2, sent), sent),
+        value=place(jnp.where(live, pairv, 0), jnp.int32(0)),
+        valid=place(live, jnp.bool_(False)),
+    )
+
+
 def segment_reduce_sorted(batch: KVBatch, op: str = "sum") -> KVBatch:
     """Reduce a key-sorted batch: one output record per distinct key.
 
@@ -275,14 +362,18 @@ def merge_batches(
 ) -> tuple[KVBatch, KVBatch]:
     """Merge per-chunk partials into a running distinct-key state.
 
-    PRECONDITION: ``state`` is key-sorted (ascending, SENTINEL padding
-    last) — true by construction everywhere: the initial state is all
-    SENTINEL and every new_state below is sorted. The update need not be
-    sorted unless the caller promises it via ``update_sorted`` (all
-    count_unique outputs are; host-scan packed updates are not). The big
-    state is then never re-sorted: the update is rank-merged in
-    (merge_sorted_runs), so each merge costs O(update log state + cap)
-    instead of the former O(cap log cap) full lax.sort per chunk.
+    PRECONDITIONS: ``state`` is key-sorted and key-distinct (ascending,
+    SENTINEL padding last) — true by construction everywhere: the initial
+    state is all SENTINEL and every new_state below is a reduced sort.
+    ``update_sorted=True`` additionally promises the update is key-sorted
+    AND key-distinct (all count_unique outputs are); otherwise the update
+    is count_unique'd here first (host-scan packed updates are distinct
+    but unsorted — the dedup is a no-op, the small sort is the point).
+    The big state is then never re-sorted OR segment-reduced: the update
+    is rank-merged in (merge_sorted_runs) and runs collapse by one-step
+    neighbor combines (combine_adjacent_unique), so each merge costs
+    O(update log state + cap) elementwise work instead of the former
+    O(cap log cap) full lax.sort plus seven segment ops per chunk.
 
     Returns ``(new_state, evicted)``. ``new_state`` keeps the smallest
     ``state.capacity`` distinct keys (sorted ascending); any overflow — the
@@ -298,8 +389,10 @@ def merge_batches(
     cap = state.capacity
     by_value = op in _VALUE_KEYED_OPS
     if not update_sorted:
-        update = sort_kv(update, by_value=by_value)
-    merged = segment_reduce_sorted(
+        # count_unique, not a bare sort: it also DEDUPS, establishing the
+        # key-distinct side contract combine_adjacent_unique needs.
+        update = count_unique(update, op=op)
+    merged = combine_adjacent_unique(
         merge_sorted_runs(state, update, by_value=by_value), op=op
     )
     head = KVBatch(merged.k1[:cap], merged.k2[:cap], merged.value[:cap], merged.valid[:cap])

@@ -172,9 +172,15 @@ class Dictionary:
                 prev = word_of.get(key)
                 if prev is not None and prev != w:
                     self.collisions.append((prev, w))
-                # prev None + stored len: the word was flushed to a run —
-                # dedup holds; an equal-pair different word here goes
-                # undetected (class-docstring degradation).
+                elif prev is None:
+                    # Flushed word recurring after a budget flush: dedup
+                    # held via _stored_len, but it must NOT rejoin _seen —
+                    # that set would regrow toward the whole vocabulary,
+                    # defeating the budget (it costs a re-hash per later
+                    # recurrence on this fallback path; bounded beats fast
+                    # here). An equal-pair different word goes undetected
+                    # (class-docstring degradation).
+                    seen.discard(w)
         self._maybe_flush()
         return added
 

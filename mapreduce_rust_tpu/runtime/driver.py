@@ -117,8 +117,13 @@ def _host_fingerprint() -> str:
             )
     except OSError:
         flags = ""
+    # JAX_PLATFORMS joins the key: a pure-CPU process and an
+    # accelerator-plugin process on the SAME machine compile CPU entries
+    # with different XLA target pseudo-features (prefer-no-scatter/gather),
+    # and loading across that line warns "could lead to SIGILL".
     h = hashlib.sha256(
-        f"{jax.__version__}:{platform.machine()}:{flags}".encode()
+        f"{jax.__version__}:{platform.machine()}:{flags}:"
+        f"{os.environ.get('JAX_PLATFORMS', '')}".encode()
     ).hexdigest()[:12]
     return f"{platform.machine()}-{h}"
 
@@ -304,14 +309,27 @@ class HostAccumulator:
     def fold_arrays(self) -> np.ndarray:
         """The exact fold as sorted rows [n, 3] (k1, k2, value) — one row
         per distinct key (scalar ops) or per distinct (key, value) pair
-        ("distinct"). Merges disk runs one at a time, so peak memory is
-        O(result + one run), never O(everything spilled)."""
-        rows = (
-            self._pending_rows() if self._keys
-            else np.empty((0, 3), np.int64)
-        )
+        ("distinct"). Runs merge through a binary-counter tree (LSM-style:
+        equal-size partials merge first), so a K-run fold costs
+        O(total log K) combine work instead of re-combining the full
+        accumulated result once per run; peak memory stays O(result)."""
+        stack: list[tuple[int, np.ndarray]] = []  # (level, rows)
+
+        def push(rows: np.ndarray) -> None:
+            level = 0
+            while stack and stack[-1][0] == level:
+                _, prev = stack.pop()
+                rows = self._combine_sorted(prev, rows)
+                level += 1
+            stack.append((level, rows))
+
         for path in self._runs:
-            rows = self._combine_sorted(rows, np.load(path))
+            push(np.load(path))
+        if self._keys:
+            push(self._pending_rows())
+        rows = np.empty((0, 3), np.int64)
+        for _, r in stack:
+            rows = self._combine_sorted(rows, r)
         return rows
 
     def snapshot(self) -> tuple[np.ndarray, np.ndarray]:

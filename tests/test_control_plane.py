@@ -216,13 +216,22 @@ def test_cluster_survives_worker_death_mid_reduce(tmp_path):
             _time.sleep(1.5)  # long past the 1.0 s lease timeout
             super().run_reduce_task(tid)
 
+    class SurvivorWorker(Worker):
+        def run_reduce_task(self, tid: int) -> None:
+            # Don't let the fast survivor sweep all reduce tasks before
+            # the victim claims one — the kill window must be guaranteed,
+            # not a scheduling race. (Runs on an executor thread: blocking
+            # here never starves the event loop or the lease renewals.)
+            started.wait(timeout=20)
+            super().run_reduce_task(tid)
+
     async def cluster():
         coord = Coordinator(cfg)
         serve = asyncio.create_task(coord.serve())
         await asyncio.sleep(0.1)
         victim_w = SlowReduceWorker(cfg, engine="host")
         victim = asyncio.create_task(victim_w.run())
-        survivor = asyncio.create_task(Worker(cfg, engine="host").run())
+        survivor = asyncio.create_task(SurvivorWorker(cfg, engine="host").run())
         # Deterministic: wait until the victim is INSIDE a reduce task
         # (holding its lease), then kill it mid-flight.
         deadline = asyncio.get_running_loop().time() + 30

@@ -189,6 +189,24 @@ def test_merge_after_clamped_update_stays_sorted_and_exact():
     assert batch_to_dict(state) == {(2, 2): 1, (9, 9): 1, (5, 5): 2, (1, 1): 1}
 
 
+def test_merge_sentinel_hashed_word_exact():
+    # A real word can (2^-64) hash to the (SENTINEL, SENTINEL) pair — its
+    # records land inside the padding run, possibly separated from their
+    # cross-side twin. The masked-reduction fix in combine_adjacent_unique
+    # must still sum both sides exactly (hashing.py documents this corner).
+    S = int(SENTINEL)
+    state = count_unique(make_batch([(S, S), (3, 3)], [5, 1], 8))
+    upd = count_unique(make_batch([(S, S), (4, 4)], [7, 1], 8))
+    new_state, ev = merge_batches(state, upd, update_sorted=True)
+    assert not np.asarray(ev.valid).any()
+    assert batch_to_dict(new_state) == {(S, S): 12, (3, 3): 1, (4, 4): 1}
+    # max over the sentinel run, both sides valid
+    st = count_unique(make_batch([(S, S)], [5], 8), op="max")
+    up = count_unique(make_batch([(S, S), (1, 1)], [9, 2], 8), op="max")
+    out, _ = merge_batches(st, up, op="max", update_sorted=True)
+    assert batch_to_dict(out) == {(S, S): 9, (1, 1): 2}
+
+
 def test_merge_update_larger_than_state():
     # Replay tiers can pass an update WIDER than the state (full-width
     # u_cap > merge_capacity): rank-merge must handle na < nb.
