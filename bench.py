@@ -110,6 +110,37 @@ ZIPF_VOCAB = 1 << 21   # 2M distinct tokens — BASELINE.json config 2 class
 ZIPF_S = 1.05          # exponent: heavy head, massive distinct tail
 
 
+def _zipf_sampler(vocab: int, s: float):
+    """(cdf, token_table) — THE shared inverse-CDF Zipf sampler both
+    high-cardinality legs draw from (one copy: a distribution tweak must
+    hit word_count and inverted_index identically). Token rank r is the
+    fixed 8-byte b'w%06x '."""
+    import numpy as np
+
+    weights = 1.0 / np.arange(1, vocab + 1, dtype=np.float64) ** s
+    cdf = np.cumsum(weights)
+    cdf /= cdf[-1]
+    table = np.frombuffer(
+        b"".join(b"w%06x " % r for r in range(vocab)), dtype=np.uint8
+    ).reshape(vocab, 8)
+    return cdf, table
+
+
+def _write_zipf_tokens(f, rng, cdf, table, n_tokens: int, on_block) -> None:
+    """Stream n_tokens sampled tokens into f in 4M-token blocks;
+    on_block(ranks) records the generator-side ground truth."""
+    import numpy as np
+
+    left = n_tokens
+    while left > 0:
+        block = min(left, 4 << 20)
+        ranks = np.searchsorted(cdf, rng.random(block))
+        on_block(ranks)
+        f.write(table[ranks].tobytes())
+        left -= block
+    f.write(b"\n")
+
+
 def build_zipf_corpus(target_mb: int, vocab: int = ZIPF_VOCAB,
                       s: float = ZIPF_S) -> tuple[pathlib.Path, pathlib.Path]:
     """Deterministic high-cardinality corpus (VERDICT r4 missing 2): tokens
@@ -130,27 +161,18 @@ def build_zipf_corpus(target_mb: int, vocab: int = ZIPF_VOCAB,
         return out, counts_p
     BENCH_DIR.mkdir(exist_ok=True)
     rng = np.random.default_rng(20260730)
-    weights = 1.0 / np.arange(1, vocab + 1, dtype=np.float64) ** s
-    cdf = np.cumsum(weights)
-    cdf /= cdf[-1]
-    # Fixed-width token table: rank r → b'w%06x ' (8 bytes incl. space).
-    table = np.frombuffer(
-        b"".join(b"w%06x " % r for r in range(vocab)), dtype=np.uint8
-    ).reshape(vocab, 8)
+    cdf, table = _zipf_sampler(vocab, s)
     counts = np.zeros(vocab, dtype=np.int64)
-    tokens_needed = (target_mb << 20) // 8 + 1
     try:
         with open(out, "wb") as f:
-            left = tokens_needed
-            while left > 0:
-                block = min(left, 4 << 20)
-                ranks = np.searchsorted(cdf, rng.random(block))
-                counts += np.bincount(ranks, minlength=vocab)
-                f.write(table[ranks].tobytes())
-                left -= block
-            f.write(b"\n")
-        with open(counts_p, "wb") as f:
+            _write_zipf_tokens(
+                f, rng, cdf, table, (target_mb << 20) // 8 + 1,
+                lambda ranks: counts.__iadd__(np.bincount(ranks, minlength=vocab)),
+            )
+        tmp = counts_p.with_suffix(".npy.tmp")
+        with open(tmp, "wb") as f:
             np.save(f, counts)
+        os.replace(tmp, counts_p)
     except BaseException:
         for p in (out, counts_p):
             try:
@@ -217,6 +239,101 @@ def zipf_leg(target_mb: int) -> None:
             "replays": s.partial_overflow_replays,
             "dict_words": s.dictionary_words,
             "map_engine": cfg.map_engine,
+        }
+    }))
+    if not exact:
+        raise SystemExit(3)
+
+
+def zipf_ii_leg(target_mb: int, n_docs: int = 8) -> None:
+    """Runs in a subprocess (--zipf-ii): INVERTED INDEX over a multi-doc
+    Zipf corpus, budgets engaged, posting lists verified exactly against
+    the generator's presence matrix (VERDICT r4 next-round 3 names both
+    word_count and inverted_index). Prints one JSON detail line."""
+    import numpy as np
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    print(f"BENCH_DEVICE_READY {platform}", file=sys.stderr, flush=True)
+
+    from mapreduce_rust_tpu.apps import InvertedIndex
+    from mapreduce_rust_tpu.config import Config
+    from mapreduce_rust_tpu.runtime.driver import enable_compilation_cache, run_job
+
+    enable_compilation_cache("auto")
+    vocab = ZIPF_VOCAB
+    base = BENCH_DIR / f"zipf-ii-{target_mb}mb"
+    docs = [base.with_name(base.name + f"-d{d}.txt") for d in range(n_docs)]
+    pres_p = base.with_name(base.name + ".presence.npy")
+    if not (pres_p.exists() and all(p.exists() for p in docs)):
+        BENCH_DIR.mkdir(exist_ok=True)
+        rng = np.random.default_rng(20260731)
+        cdf, table = _zipf_sampler(vocab, ZIPF_S)
+        presence = np.zeros((vocab, n_docs), dtype=bool)
+        per_doc = (target_mb << 20) // (8 * n_docs) + 1
+        try:
+            for d, path in enumerate(docs):
+
+                def on_block(ranks, _d=d):
+                    presence[:, _d] |= np.bincount(ranks, minlength=vocab) > 0
+
+                with open(path, "wb") as f:
+                    _write_zipf_tokens(f, rng, cdf, table, per_doc, on_block)
+            # Presence commits LAST, atomically: its existence implies the
+            # doc files are complete — a torn generator run can never feed
+            # the exactness check a bogus ground truth.
+            tmp = pres_p.with_suffix(".npy.tmp")
+            with open(tmp, "wb") as f:
+                np.save(f, presence)
+            os.replace(tmp, pres_p)
+        except BaseException:
+            for p in [pres_p, *docs]:
+                try:
+                    p.unlink()
+                except OSError:
+                    pass
+            raise
+    presence = np.load(pres_p)
+
+    cfg = Config(
+        map_engine=os.environ.get("BENCH_MAP_ENGINE", "host"),
+        host_window_bytes=16 << 20,
+        chunk_bytes=1 << 20,
+        merge_capacity=1 << 18,
+        host_accum_budget_mb=256,
+        dictionary_budget_words=1 << 19,
+        reduce_n=8,
+        work_dir=str(BENCH_DIR / "zipf-ii-work"),
+        output_dir=str(BENCH_DIR / "zipf-ii-out"),
+        device="auto",
+    )
+    import shutil
+
+    shutil.rmtree(cfg.work_dir, ignore_errors=True)
+    t0 = time.perf_counter()
+    res = run_job(cfg, [str(p) for p in docs], app=InvertedIndex())
+    dt = time.perf_counter() - t0
+    s = res.stats
+    got = np.zeros((vocab, presence.shape[1]), dtype=bool)
+    n_lines = 0
+    for f in res.output_files:
+        with open(f, "rb") as fh:
+            for line in fh:
+                w, v = line.rsplit(b" ", 1)
+                got[int(w[1:], 16), [int(x) for x in v.split(b",")]] = True
+                n_lines += 1
+    exact = bool(np.array_equal(got, presence))
+    print(json.dumps({
+        "zipf_ii": {
+            "bytes": s.bytes_in, "wall_s": round(dt, 3),
+            "gbs": round(s.gb_per_s, 4), "platform": platform,
+            "distinct_terms": n_lines,
+            "expected_terms": int(presence.any(axis=1).sum()),
+            "posting_pairs": int(presence.sum()), "docs": presence.shape[1],
+            "exact": exact,
+            "spills": s.spill_events, "spilled_keys": s.spilled_keys,
+            "dict_words": s.dictionary_words,
         }
     }))
     if not exact:
@@ -704,6 +821,8 @@ if __name__ == "__main__":
         micro_leg()
     elif len(sys.argv) > 1 and sys.argv[1] == "--zipf":
         zipf_leg(int(sys.argv[2]))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--zipf-ii":
+        zipf_ii_leg(int(sys.argv[2]))
     else:
         try:
             main()

@@ -107,6 +107,14 @@ class Config:
     resume: bool = False            # start from work_dir/driver.ckpt when it
                                     # matches this job's fingerprint
 
+    multihost_barrier_timeout_s: float = 120.0  # how long a multi-process
+                                    # run waits at the dictionary-exchange
+                                    # barrier for every peer's shard before
+                                    # failing the job (a dead peer cannot
+                                    # be recovered here: its chips' hash
+                                    # classes died with it — fail loudly,
+                                    # rerun the job)
+
     # ---- Control plane (reference timings preserved) ----
     host: str = "127.0.0.1"
     port: int = 1040

@@ -1080,18 +1080,38 @@ def _stream_multihost(cfg: Config, app: App, inputs, stats, acc, dictionary) -> 
     dictionary.save(tmp)
     os.replace(tmp, shard_path(pid))
     open(shard_path(pid) + ".done", "w").close()
-    deadline = time.time() + 120
-    for other in range(nproc):
-        while not (
-            os.path.exists(shard_path(other) + ".done")
-            and os.path.exists(shard_path(other))
-        ):
-            if time.time() > deadline:
-                raise TimeoutError(f"dictionary shard from process {other} never arrived")
-            time.sleep(0.05)
+    _await_shard_files(shard_path, nproc, cfg.multihost_barrier_timeout_s)
     for other in range(nproc):
         if other != pid:
             dictionary.merge(Dictionary.load(shard_path(other)))
+
+
+def _await_shard_files(shard_path, nproc: int, timeout_s: float) -> None:
+    """The multihost dictionary-exchange barrier: wait for every process's
+    published shard + done marker. A peer that died before publishing
+    cannot be waited out — its chips' hash classes died with it — so the
+    only honest outcome is a loud, prompt failure naming every missing
+    rank (the timeout is a knob: slow shared filesystems legitimately
+    need more than the default)."""
+    deadline = time.monotonic() + timeout_s  # immune to wall-clock steps
+    waiting = set(range(nproc))
+    while waiting:
+        waiting -= {
+            other for other in waiting
+            if os.path.exists(shard_path(other) + ".done")
+            and os.path.exists(shard_path(other))
+        }
+        if not waiting:
+            return
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"dictionary shards from process(es) {sorted(waiting)} never "
+                f"arrived within {timeout_s:.0f}s (multihost_barrier_timeout_s)"
+                " — peer death or a stalled shared work dir; results would be"
+                " missing those hash classes, so the job fails instead."
+                " Re-run the job."
+            )
+        time.sleep(0.05)
 
 
 def _finish_mesh_state(app: App, mesh, state, stats, acc) -> None:

@@ -92,6 +92,23 @@ def test_budgeted_inverted_index_exact(tmp_path):
     assert r1.table  # the RAM path still returns the table
 
 
+def test_budgeted_mesh_run_exact(tmp_path):
+    # The tiers + streaming egress must compose with the mesh driver too:
+    # spills arrive via the sharded evicted tails, the dictionary via the
+    # ingest scans — same files out as the plain mesh run.
+    inputs = write_corpus(tmp_path)
+    plain = cfg_for(tmp_path, "mesh-plain", mesh_shape=4)
+    run_job(plain, inputs)
+    tiered = cfg_for(
+        tmp_path, "mesh-tiered", mesh_shape=4,
+        host_accum_budget_mb=0, dictionary_budget_words=512,
+    )
+    res = run_job(tiered, inputs)
+    assert res.stats.mesh_rounds > 0
+    assert read_outputs(tiered) == read_outputs(plain)
+    assert res.stats.unknown_keys == 0
+
+
 def test_accumulator_runs_fold_exactly(tmp_path):
     rng = np.random.default_rng(3)
     plain = HostAccumulator("sum")
