@@ -110,6 +110,44 @@ ZIPF_VOCAB = 1 << 21   # 2M distinct tokens — BASELINE.json config 2 class
 ZIPF_S = 1.05          # exponent: heavy head, massive distinct tail
 
 
+def _atomic_np_save(path: pathlib.Path, arr) -> None:
+    """Commit a ground-truth array atomically (tmp + rename), cleaning the
+    tmp on failure — shared by both high-cardinality legs."""
+    import numpy as np
+
+    tmp = path.with_suffix(".npy.tmp")
+    try:
+        with open(tmp, "wb") as f:
+            np.save(f, arr)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            tmp.unlink()
+        except OSError:
+            pass
+        raise
+
+
+def _zipf_cfg(work: str, out: str, reduce_n: int):
+    """THE budgets-engaged config both high-cardinality legs run under —
+    one copy, so the conditions 'budgets engaged / eviction constant'
+    cannot silently diverge between word_count and inverted_index."""
+    from mapreduce_rust_tpu.config import Config
+
+    return Config(
+        map_engine=os.environ.get("BENCH_MAP_ENGINE", "host"),
+        host_window_bytes=16 << 20,
+        chunk_bytes=1 << 20,
+        merge_capacity=1 << 18,        # << the Zipf vocab: constant eviction
+        host_accum_budget_mb=256,      # spill-run tier engaged
+        dictionary_budget_words=1 << 19,  # dictionary tier engaged
+        reduce_n=reduce_n,
+        work_dir=str(BENCH_DIR / work),
+        output_dir=str(BENCH_DIR / out),
+        device="auto",
+    )
+
+
 def _zipf_sampler(vocab: int, s: float):
     """(cdf, token_table) — THE shared inverse-CDF Zipf sampler both
     high-cardinality legs draw from (one copy: a distribution tweak must
@@ -169,10 +207,7 @@ def build_zipf_corpus(target_mb: int, vocab: int = ZIPF_VOCAB,
                 f, rng, cdf, table, (target_mb << 20) // 8 + 1,
                 lambda ranks: counts.__iadd__(np.bincount(ranks, minlength=vocab)),
             )
-        tmp = counts_p.with_suffix(".npy.tmp")
-        with open(tmp, "wb") as f:
-            np.save(f, counts)
-        os.replace(tmp, counts_p)
+        _atomic_np_save(counts_p, counts)
     except BaseException:
         for p in (out, counts_p):
             try:
@@ -194,24 +229,12 @@ def zipf_leg(target_mb: int) -> None:
     platform = jax.devices()[0].platform
     print(f"BENCH_DEVICE_READY {platform}", file=sys.stderr, flush=True)
 
-    from mapreduce_rust_tpu.config import Config
     from mapreduce_rust_tpu.runtime.driver import enable_compilation_cache, run_job
 
     enable_compilation_cache("auto")
     corpus, counts_p = build_zipf_corpus(target_mb)
     truth = np.load(counts_p)
-    cfg = Config(
-        map_engine=os.environ.get("BENCH_MAP_ENGINE", "host"),
-        host_window_bytes=16 << 20,
-        chunk_bytes=1 << 20,
-        merge_capacity=1 << 18,        # << 2M vocab → constant eviction
-        host_accum_budget_mb=256,      # spill-run tier engaged
-        dictionary_budget_words=1 << 19,  # dictionary tier engaged
-        reduce_n=8,
-        work_dir=str(BENCH_DIR / "zipf-work"),
-        output_dir=str(BENCH_DIR / "zipf-out"),
-        device="auto",
-    )
+    cfg = _zipf_cfg("zipf-work", "zipf-out", reduce_n=8)
     import shutil
 
     shutil.rmtree(cfg.work_dir, ignore_errors=True)
@@ -258,12 +281,12 @@ def zipf_ii_leg(target_mb: int, n_docs: int = 8) -> None:
     print(f"BENCH_DEVICE_READY {platform}", file=sys.stderr, flush=True)
 
     from mapreduce_rust_tpu.apps import InvertedIndex
-    from mapreduce_rust_tpu.config import Config
     from mapreduce_rust_tpu.runtime.driver import enable_compilation_cache, run_job
 
     enable_compilation_cache("auto")
     vocab = ZIPF_VOCAB
-    base = BENCH_DIR / f"zipf-ii-{target_mb}mb"
+    base = BENCH_DIR / f"zipf-ii-{target_mb}mb-n{n_docs}"  # n_docs keys the
+    # cache: a different doc split must never reuse another's ground truth
     docs = [base.with_name(base.name + f"-d{d}.txt") for d in range(n_docs)]
     pres_p = base.with_name(base.name + ".presence.npy")
     if not (pres_p.exists() and all(p.exists() for p in docs)):
@@ -283,10 +306,7 @@ def zipf_ii_leg(target_mb: int, n_docs: int = 8) -> None:
             # Presence commits LAST, atomically: its existence implies the
             # doc files are complete — a torn generator run can never feed
             # the exactness check a bogus ground truth.
-            tmp = pres_p.with_suffix(".npy.tmp")
-            with open(tmp, "wb") as f:
-                np.save(f, presence)
-            os.replace(tmp, pres_p)
+            _atomic_np_save(pres_p, presence)
         except BaseException:
             for p in [pres_p, *docs]:
                 try:
@@ -295,19 +315,9 @@ def zipf_ii_leg(target_mb: int, n_docs: int = 8) -> None:
                     pass
             raise
     presence = np.load(pres_p)
+    assert presence.shape[1] == n_docs, "stale ground truth for this doc split"
 
-    cfg = Config(
-        map_engine=os.environ.get("BENCH_MAP_ENGINE", "host"),
-        host_window_bytes=16 << 20,
-        chunk_bytes=1 << 20,
-        merge_capacity=1 << 18,
-        host_accum_budget_mb=256,
-        dictionary_budget_words=1 << 19,
-        reduce_n=8,
-        work_dir=str(BENCH_DIR / "zipf-ii-work"),
-        output_dir=str(BENCH_DIR / "zipf-ii-out"),
-        device="auto",
-    )
+    cfg = _zipf_cfg("zipf-ii-work", "zipf-ii-out", reduce_n=8)
     import shutil
 
     shutil.rmtree(cfg.work_dir, ignore_errors=True)

@@ -217,6 +217,77 @@ def test_merge_update_larger_than_state():
     assert combined == {(0, 0): 1, (1, 1): 4, (5, 5): 4, (6, 6): 1, (7, 7): 1, (9, 9): 1}
 
 
+def test_merge_batches_fuzz_vs_oracle():
+    # Property fuzz of the whole rank-merge + pair-combine path: random
+    # update streams (random sizes, duplicate raw keys, occasional
+    # sentinel-pair keys, occasional clamped updates, all four ops) folded
+    # through merge_batches; state + evictions must always equal the
+    # oracle fold. Seeded — failures reproduce.
+    from mapreduce_rust_tpu.ops.groupby import clamp_batch
+
+    rng = np.random.default_rng(42)
+    S = int(SENTINEL)
+    for op, fold in (("sum", lambda a, b: a + b), ("max", max), ("min", min)):
+        for cap, u_cap, rounds in ((16, 8, 6), (64, 32, 5), (32, 64, 4)):
+            state = KVBatch.empty(cap)
+            oracle: dict = {}
+            for r in range(rounds):
+                n = int(rng.integers(0, u_cap + 1))
+                keys = rng.integers(0, 12, size=(n, 2)).astype(np.uint32)
+                if n and rng.random() < 0.3:
+                    keys[0] = (S, S)  # the 2^-64 corner, made common
+                vals = rng.integers(-50, 50, size=n).astype(np.int32)
+                upd = count_unique(make_batch(keys, vals, u_cap), op=op)
+                if rng.random() < 0.2:
+                    upd = clamp_batch(upd, jnp.bool_(False))  # overflow clamp
+                else:
+                    o: dict = {}
+                    for (a, b), v in zip(keys.tolist(), vals.tolist()):
+                        o[(a, b)] = fold(o[(a, b)], v) if (a, b) in o else v
+                    for k, v in o.items():
+                        oracle[k] = fold(oracle[k], v) if k in oracle else v
+                state, ev = merge_batches(state, upd, op=op, update_sorted=True)
+                # evictions fold to the host exactly (spill contract)
+                for k, v in batch_to_dict(ev).items():
+                    oracle_v = oracle.pop(k)
+                    assert v == oracle_v, (op, cap, r, k)
+                k1 = np.asarray(state.k1)
+                assert (k1[:-1] <= k1[1:]).all(), "state must stay sorted"
+            assert batch_to_dict(state) == oracle, (op, cap)
+
+
+def test_merge_batches_fuzz_distinct_op():
+    # Same property fuzz for the value-keyed op: (key, doc) sets must
+    # stay exact through merges, evictions and clamps.
+    from mapreduce_rust_tpu.ops.groupby import clamp_batch
+
+    rng = np.random.default_rng(7)
+    cap, u_cap = 32, 16
+    state = KVBatch.empty(cap)
+    oracle: dict = {}
+    for r in range(8):
+        n = int(rng.integers(0, u_cap + 1))
+        keys = rng.integers(0, 8, size=(n, 2)).astype(np.uint32)
+        docs = rng.integers(0, 5, size=n).astype(np.int32)
+        upd = count_unique(make_batch(keys, docs, u_cap), op="distinct")
+        if rng.random() < 0.2:
+            upd = clamp_batch(upd, jnp.bool_(False))
+        else:
+            for (a, b), d in zip(keys.tolist(), docs.tolist()):
+                oracle.setdefault((a, b), set()).add(d)
+        state, ev = merge_batches(state, upd, op="distinct", update_sorted=True)
+        ekeys, evals = ev.to_host()
+        for (a, b), d in zip(map(tuple, ekeys.tolist()), evals.tolist()):
+            oracle[(a, b)].remove(d)  # KeyError = wrong eviction
+            if not oracle[(a, b)]:
+                del oracle[(a, b)]
+    got: dict = {}
+    skeys, svals = state.to_host()
+    for (a, b), d in zip(map(tuple, skeys.tolist()), svals.tolist()):
+        got.setdefault((a, b), set()).add(d)
+    assert got == oracle
+
+
 def test_bucket_scatter_routes_by_k1_mod():
     nb, cap = 4, 8
     keys = [(k1, 7) for k1 in [0, 1, 2, 3, 4, 5, 8, 9]]
