@@ -109,6 +109,43 @@ def test_budgeted_mesh_run_exact(tmp_path):
     assert res.stats.unknown_keys == 0
 
 
+def test_budgeted_grep_filtering_app_exact(tmp_path):
+    # A FILTERING app under budgets: only query keys reach the fold and
+    # the dictionary, so the streaming join must emit exactly the query's
+    # posting lists and nothing else.
+    from mapreduce_rust_tpu.apps.grep import Grep
+
+    inputs = write_corpus(tmp_path)
+    query = ("tok00007", "tok01234", "tok02999")
+    plain = cfg_for(tmp_path, "grep-plain")
+    run_job(plain, inputs, app=Grep(query=query))
+    tiered = cfg_for(tmp_path, "grep-tiered", host_accum_budget_mb=0,
+                     dictionary_budget_words=2)
+    res = run_job(tiered, inputs, app=Grep(query=query))
+    assert res.table == {}  # the STREAMING join engaged, not the fallback
+    assert read_outputs(tiered) == read_outputs(plain)
+    got = b"".join(read_outputs(tiered).values())
+    for w in query:
+        assert w.encode() in got
+    assert res.stats.unknown_keys == 0
+
+
+def test_topk_finalize_override_rehydrates_exactly(tmp_path):
+    # top_k overrides App.finalize (global selection), so a spilled
+    # dictionary cannot stream — run_job must fall back to the rehydrate
+    # path (exact, unbounded) and still produce the right top-k.
+    from mapreduce_rust_tpu.apps import TopK
+
+    inputs = write_corpus(tmp_path)
+    plain = cfg_for(tmp_path, "topk-plain")
+    r1 = run_job(plain, inputs, app=TopK(k=5))
+    tiered = cfg_for(tmp_path, "topk-tiered", dictionary_budget_words=256)
+    r2 = run_job(tiered, inputs, app=TopK(k=5))
+    assert read_outputs(tiered) == read_outputs(plain)
+    assert r2.table == r1.table  # rehydrate path returns the full table
+    assert r2.stats.unknown_keys == 0
+
+
 def test_accumulator_runs_fold_exactly(tmp_path):
     rng = np.random.default_rng(3)
     plain = HostAccumulator("sum")
