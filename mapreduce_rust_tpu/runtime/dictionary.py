@@ -213,28 +213,85 @@ class Dictionary:
         suspicious = np.nonzero(~known)[0]
         added = 0
         if len(suspicious):
-            packed_l = packed.tolist()
-            ends_l = ends.tolist()
-            len_of, word_of, seen = self._len_of, self._word_of, self._seen
-            for i in suspicious.tolist():
-                end = ends_l[i]
-                prev_end = ends_l[i - 1] if i else 0
-                wlen = end - prev_end
-                p = packed_l[i]
-                stored = self._stored_len(p)
-                if stored is None:
-                    w = raw[prev_end:end]
-                    len_of[p] = wlen
-                    seen.add(w)
-                    key = (int(keys[i, 0]), int(keys[i, 1]))
-                    if key not in word_of:
-                        word_of[key] = w
-                        added += 1
-                        self._total_words += 1
-                    self._fresh_keys.append(p)
-                    self._fresh_lens.append(wlen)
-                elif stored != wlen:
-                    w = raw[prev_end:end]
+            # Vectorized tier membership for the whole suspicious batch:
+            # merged tier via searchsorted, unmerged tier via np.isin over
+            # the fresh buffer. Their union IS _len_of's key set (inserts
+            # feed _fresh_keys; _merge_fresh moves them to _packed_sorted),
+            # so no per-key dict probe is needed to find the NEW keys —
+            # the per-key Python loop here was the high-cardinality ingest
+            # bottleneck (≈half the host-glue time at 1e6 distinct/window).
+            p_sus = packed[suspicious]
+            if len(self._packed_sorted):
+                # Reuse the full-batch bisection from the fast path above.
+                idx_sus = idx_c[suspicious]
+                in_sorted = self._packed_sorted[idx_sus] == p_sus
+                sorted_lens = self._sorted_lens[idx_sus]
+            else:
+                in_sorted = np.zeros(len(p_sus), dtype=bool)
+                sorted_lens = np.zeros(len(p_sus), dtype=np.int64)
+            if self._fresh_keys:
+                in_fresh = np.isin(p_sus, np.asarray(self._fresh_keys, dtype=np.uint64))
+            else:
+                in_fresh = np.zeros(len(p_sus), dtype=bool)
+            new_mask = ~in_sorted & ~in_fresh
+
+            new_i = suspicious[new_mask]
+            if len(new_i):
+                # Intra-batch pair collisions (two DIFFERENT words, equal
+                # packed key, in one window): keep the FIRST occurrence
+                # (scan order = first occurrence order) and record the
+                # rest — 'checked, not assumed' (module docstring) even
+                # inside a single batch.
+                if len(np.unique(packed[new_i])) != len(new_i):
+                    _uniq, first_pos = np.unique(packed[new_i], return_index=True)
+                    keep = np.zeros(len(new_i), dtype=bool)
+                    keep[first_pos] = True
+                    dup_i = new_i[~keep]
+                    new_i = new_i[keep]
+                else:
+                    dup_i = new_i[:0]
+                starts = np.where(new_i > 0, ends[new_i - 1], 0)
+                words = [
+                    raw[s:e]
+                    for s, e in zip(starts.tolist(), ends[new_i].tolist())
+                ]
+                key_pairs = list(
+                    zip(keys[new_i, 0].tolist(), keys[new_i, 1].tolist())
+                )
+                p_new = packed[new_i].tolist()
+                w_new = wlens[new_i].tolist()
+                # Batch C-loop updates (keys unique within the batch after
+                # the dedup above and new to both tiers — no clobbering).
+                self._word_of.update(zip(key_pairs, words))
+                self._seen.update(words)
+                self._len_of.update(zip(p_new, w_new))
+                self._fresh_keys.extend(p_new)
+                self._fresh_lens.extend(w_new)
+                added += len(words)
+                self._total_words += len(words)
+                for i, s in zip(dup_i.tolist(),
+                                np.where(dup_i > 0, ends[dup_i - 1], 0).tolist()):
+                    w = raw[s:ends[i]]
+                    prev = self._word_of.get((int(keys[i, 0]), int(keys[i, 1])))
+                    if prev is not None and prev != w:
+                        self._seen.add(w)
+                        self.collisions.append((prev, w))
+
+            # Known keys whose stored length MISMATCHES: the rare
+            # collision-candidate set — per-key work is fine here.
+            mm = suspicious[
+                (in_sorted & (sorted_lens != wlens[suspicious]))
+                | (in_fresh & ~in_sorted)
+            ]
+            if len(mm):
+                mm_starts = np.where(mm > 0, ends[mm - 1], 0)
+                word_of, seen = self._word_of, self._seen
+                for i, s in zip(mm.tolist(), mm_starts.tolist()):
+                    e = int(ends[i])
+                    stored = self._stored_len(int(packed[i]))
+                    if stored is None or stored == e - s:
+                        continue
+                    w = raw[s:e]
                     prev = word_of.get((int(keys[i, 0]), int(keys[i, 1])))
                     if prev is not None and prev != w and w not in seen:
                         seen.add(w)
@@ -375,5 +432,12 @@ class Dictionary:
                     d._total_words += 1
                 d._word_of[(k1, k2)] = w
                 d._seen.add(w)
-                d._len_of.setdefault((k1 << 32) | k2, len(w))
+                packed = (k1 << 32) | k2
+                if packed not in d._len_of:
+                    d._len_of[packed] = len(w)
+                    # Every insert path must feed the vectorized tiers:
+                    # add_scanned_raw's membership is (merged | fresh), so
+                    # a loaded key that skipped them would be re-insertable.
+                    d._fresh_keys.append(packed)
+                    d._fresh_lens.append(len(w))
         return d

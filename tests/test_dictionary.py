@@ -65,3 +65,43 @@ def test_dictionary_merge_and_collision_detection():
     c._word_of[next(iter(a._word_of))] = b"impostor"
     a.merge(c)
     assert len(a.collisions) == 1
+
+
+def test_intra_batch_pair_collision_first_wins_and_recorded():
+    # Two DIFFERENT words with an identical (fabricated) hash pair inside
+    # ONE scan batch: first word wins, the collision is recorded, the key
+    # counted once — 'checked, not assumed' even intra-batch.
+    import numpy as np
+
+    from mapreduce_rust_tpu.runtime.dictionary import Dictionary
+
+    d = Dictionary()
+    raw = b"abcdef"  # words: 'abc' and 'def'
+    ends = np.asarray([3, 6], dtype=np.int64)
+    keys = np.asarray([[7, 9], [7, 9]], dtype=np.uint32)  # same pair!
+    added = d.add_scanned_raw(raw, ends, keys)
+    assert added == 1
+    assert len(d) == 1
+    assert d.lookup(7, 9) == b"abc"  # first wins
+    assert (b"abc", b"def") in d.collisions
+
+
+def test_load_then_ingest_does_not_reinsert():
+    # A load()-built dictionary must participate in the vectorized tier
+    # membership: re-ingesting its words may not double count or clobber.
+    import numpy as np
+
+    from mapreduce_rust_tpu.core.hashing import hash_words
+    from mapreduce_rust_tpu.runtime.dictionary import Dictionary
+
+    d1 = Dictionary()
+    d1.add_words([b"hello", b"world"])
+    path = "/tmp/dict-load-test.txt"
+    d1.save(path)
+    d2 = Dictionary.load(path)
+    raw = b"helloworld"
+    ends = np.asarray([5, 10], dtype=np.int64)
+    added = d2.add_scanned_raw(raw, ends, hash_words([b"hello", b"world"]))
+    assert added == 0
+    assert len(d2) == 2
+    assert d2.lookup(*map(int, hash_words([b"hello"])[0])) == b"hello"
