@@ -58,6 +58,8 @@ def _cfg(args, map_n: int = 1, worker_n: int = 1) -> Config:
         checkpoint_every_groups=getattr(args, "checkpoint_every", 0),
         resume=getattr(args, "resume", False),
         mesh_shape=getattr(args, "mesh", None),
+        host_accum_budget_mb=getattr(args, "accum_budget_mb", None),
+        dictionary_budget_words=getattr(args, "dict_budget_words", None),
         profile_dir=args.profile_dir,
         host=args.host,
         port=args.port,
@@ -186,6 +188,14 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--resume", action="store_true",
                    help="resume from the work dir's driver checkpoint when "
                    "it matches this job's fingerprint")
+    p.add_argument("--accum-budget-mb", type=int, default=None,
+                   dest="accum_budget_mb",
+                   help="spill-accumulator RAM budget (MB); above it, sorted "
+                        "runs go to --work and finalize streams (exact)")
+    p.add_argument("--dict-budget-words", type=int, default=None,
+                   dest="dict_budget_words",
+                   help="egress-dictionary RAM budget (words); above it, "
+                        "sorted runs go to --work and finalize streams")
     p.add_argument("--distributed", action="store_true",
                    help="join a multi-host jax.distributed cluster before "
                    "building the mesh; the all_to_all shuffle then rides "

@@ -223,12 +223,9 @@ class Dictionary:
             p_sus = packed[suspicious]
             if len(self._packed_sorted):
                 # Reuse the full-batch bisection from the fast path above.
-                idx_sus = idx_c[suspicious]
-                in_sorted = self._packed_sorted[idx_sus] == p_sus
-                sorted_lens = self._sorted_lens[idx_sus]
+                in_sorted = self._packed_sorted[idx_c[suspicious]] == p_sus
             else:
                 in_sorted = np.zeros(len(p_sus), dtype=bool)
-                sorted_lens = np.zeros(len(p_sus), dtype=np.int64)
             if self._fresh_keys:
                 in_fresh = np.isin(p_sus, np.asarray(self._fresh_keys, dtype=np.uint64))
             else:
@@ -242,8 +239,8 @@ class Dictionary:
                 # (scan order = first occurrence order) and record the
                 # rest — 'checked, not assumed' (module docstring) even
                 # inside a single batch.
-                if len(np.unique(packed[new_i])) != len(new_i):
-                    _uniq, first_pos = np.unique(packed[new_i], return_index=True)
+                _uniq, first_pos = np.unique(packed[new_i], return_index=True)
+                if len(first_pos) != len(new_i):
                     keep = np.zeros(len(new_i), dtype=bool)
                     keep[first_pos] = True
                     dup_i = new_i[~keep]
@@ -277,12 +274,10 @@ class Dictionary:
                         self._seen.add(w)
                         self.collisions.append((prev, w))
 
-            # Known keys whose stored length MISMATCHES: the rare
-            # collision-candidate set — per-key work is fine here.
-            mm = suspicious[
-                (in_sorted & (sorted_lens != wlens[suspicious]))
-                | (in_fresh & ~in_sorted)
-            ]
+            # Known keys (either tier): the rare collision-candidate set —
+            # per-key work is fine here. (A suspicious in_sorted key has a
+            # length mismatch by construction: `known` required the match.)
+            mm = suspicious[in_sorted | in_fresh]
             if len(mm):
                 mm_starts = np.where(mm > 0, ends[mm - 1], 0)
                 word_of, seen = self._word_of, self._seen

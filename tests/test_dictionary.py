@@ -86,7 +86,7 @@ def test_intra_batch_pair_collision_first_wins_and_recorded():
     assert (b"abc", b"def") in d.collisions
 
 
-def test_load_then_ingest_does_not_reinsert():
+def test_load_then_ingest_does_not_reinsert(tmp_path):
     # A load()-built dictionary must participate in the vectorized tier
     # membership: re-ingesting its words may not double count or clobber.
     import numpy as np
@@ -96,7 +96,7 @@ def test_load_then_ingest_does_not_reinsert():
 
     d1 = Dictionary()
     d1.add_words([b"hello", b"world"])
-    path = "/tmp/dict-load-test.txt"
+    path = str(tmp_path / "dict-load-test.txt")
     d1.save(path)
     d2 = Dictionary.load(path)
     raw = b"helloworld"
