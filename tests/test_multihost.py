@@ -26,9 +26,9 @@ _WORKER = textwrap.dedent(
     import os, sys
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
-    pid, port, base = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+    pid, nproc, port, base = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
     from mapreduce_rust_tpu.parallel.distributed import initialize, is_federated
-    initialize(f"127.0.0.1:{port}", num_processes=2, process_id=pid)
+    initialize(f"127.0.0.1:{port}", num_processes=nproc, process_id=pid)
     import jax
     if not is_federated():
         print(f"NOT_FEDERATED global={jax.device_count()} local={jax.local_device_count()}")
@@ -37,9 +37,9 @@ _WORKER = textwrap.dedent(
     from mapreduce_rust_tpu.config import Config
     from mapreduce_rust_tpu.runtime.driver import run_job
     app = None
-    if len(sys.argv) > 4 and sys.argv[4] == "grep":
+    if len(sys.argv) > 5 and sys.argv[5] == "grep":
         from mapreduce_rust_tpu.apps.grep import Grep
-        app = Grep(query=tuple(sys.argv[5].split(",")))
+        app = Grep(query=tuple(sys.argv[6].split(",")))
     inputs = sorted(glob.glob(os.path.join(base, "in", "*.txt")))
     cfg = Config(chunk_bytes=4096, merge_capacity=1 << 14, reduce_n=3,
                  mesh_shape=jax.device_count(), device="cpu",
@@ -51,9 +51,9 @@ _WORKER = textwrap.dedent(
 )
 
 
-def _run_two_processes(tmp_path, texts, extra_args=()):
-    """Launch the 2-process job; returns merged 'word value' line dict, or
-    skips if jax.distributed cannot federate CPU backends here."""
+def _run_cluster(tmp_path, texts, extra_args=(), nproc=2, timeout=240):
+    """Launch the nproc-process job; returns merged 'word value' line dict,
+    or skips if jax.distributed cannot federate CPU backends here."""
     (tmp_path / "in").mkdir()
     for i, t in enumerate(texts):
         (tmp_path / "in" / f"doc-{i}.txt").write_text(t)
@@ -62,21 +62,27 @@ def _run_two_processes(tmp_path, texts, extra_args=()):
         port = str(s.getsockname()[1])
     procs = [
         subprocess.Popen(
-            [sys.executable, "-c", _WORKER, str(pid), port, str(tmp_path),
-             *extra_args],
+            [sys.executable, "-c", _WORKER, str(pid), str(nproc), port,
+             str(tmp_path), *extra_args],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             cwd=str(REPO_ROOT), env={**os.environ, "PYTHONPATH": str(REPO_ROOT)},
         )
-        for pid in (0, 1)
+        for pid in range(nproc)
     ]
     outs = []
     for p in procs:
         try:
-            out, err = p.communicate(timeout=240)
+            out, err = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
+            tails = []
             for q in procs:
                 q.kill()
-            pytest.fail("multihost end-to-end timed out")
+                try:  # reap + collect whatever the worker said before dying
+                    qo, qe = q.communicate(timeout=10)
+                except subprocess.SubprocessError:
+                    qo, qe = "", ""
+                tails.append(f"--- rc={q.returncode} {qo[-300:]} {qe[-800:]}")
+            pytest.fail("multihost end-to-end timed out\n" + "\n".join(tails))
         outs.append((p.returncode, out, err))
     if any(rc == 3 for rc, _o, _e in outs):
         detail = "; ".join(o.strip().splitlines()[-1] for _r, o, _e in outs if o.strip())
@@ -86,7 +92,7 @@ def _run_two_processes(tmp_path, texts, extra_args=()):
         assert "OK proc=" in out
     got: dict = {}
     files = sorted((tmp_path / "out").glob("mr-*.txt"))
-    assert len(files) == 6  # reduce_n=3 × 2 processes
+    assert len(files) == 3 * nproc  # reduce_n=3 × nproc processes
     for f in files:
         for line in f.read_bytes().splitlines():
             w, v = line.rsplit(b" ", 1)
@@ -101,7 +107,7 @@ def test_two_process_end_to_end_run_job(tmp_path):
         "pack my box with five dozen liquor jugs " * 150,
         "sphinx of black quartz judge my vow " * 180,
     ]
-    got = _run_two_processes(tmp_path, texts)
+    got = _run_cluster(tmp_path, texts)
     oracle = collections.Counter()
     for t in texts:
         oracle.update(reference_word_counts(t.encode()))
@@ -117,10 +123,30 @@ def test_two_process_grep_cross_process_dictionary(tmp_path):
         "pack my box with five dozen liquor jugs " * 150,      # doc 1 → proc 1
         "sphinx of black quartz judge my vow " * 180,          # doc 2 → proc 0
     ]
-    got = _run_two_processes(
+    got = _run_cluster(
         tmp_path, texts, extra_args=("grep", "fox,jugs,sphinx,dog,absent")
     )
     assert got == {b"fox": b"0", b"jugs": b"1", b"sphinx": b"2", b"dog": b"0"}
+
+
+def test_four_process_end_to_end_run_job(tmp_path):
+    """4 localhost processes x 2 virtual devices = an 8-device global mesh
+    federated over the DCN path — the comm backend beyond the 2-process
+    minimum (4 CPU processes time-slice one core here, so inputs are small
+    and the timeout generous; the persistent compile cache dedups the
+    mesh-8 program builds across the peers)."""
+    texts = [
+        "a quick brown fox " * 60,
+        "lazy dogs sleep all day " * 50,
+        "sphinx of black quartz " * 55,
+        "pack my box with jugs " * 45,
+        "five dozen liquor jugs more " * 40,
+    ]
+    got = _run_cluster(tmp_path, texts, nproc=4, timeout=600)
+    oracle = collections.Counter()
+    for t in texts:
+        oracle.update(reference_word_counts(t.encode()))
+    assert {w.decode(): int(v) for w, v in got.items()} == dict(oracle)
 
 
 def test_barrier_names_missing_ranks_and_respects_timeout(tmp_path):
