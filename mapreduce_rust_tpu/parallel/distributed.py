@@ -34,9 +34,15 @@ _initialized = False
 
 
 def initialize(coordinator_address: str, num_processes: int, process_id: int,
-               local_device_ids=None) -> None:
+               local_device_ids=None, **kwargs) -> None:
     """Join the jax.distributed cluster (idempotent). MUST run before any
-    other jax call in the process — backend creation binds the client."""
+    other jax call in the process — backend creation binds the client.
+
+    Extra kwargs pass through to jax.distributed.initialize — notably
+    heartbeat_timeout_seconds: on heavily oversubscribed hosts (many
+    processes per core, e.g. localhost test clusters) the coordination
+    service can evict a starved-but-healthy peer at the default 100 s.
+    """
     global _initialized
     if _initialized:
         return
@@ -52,6 +58,7 @@ def initialize(coordinator_address: str, num_processes: int, process_id: int,
         num_processes=num_processes,
         process_id=process_id,
         local_device_ids=local_device_ids,
+        **kwargs,
     )
     _initialized = True
     log.info(

