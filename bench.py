@@ -554,6 +554,10 @@ def device_leg(path: str) -> None:
         reduce_n=4,
         output_dir=str(BENCH_DIR / "out"),
         device="auto",
+        # --trace/--manifest ride into this subprocess as env vars; the
+        # measured run then emits the timeline + its own run manifest.
+        trace_path=os.environ.get("BENCH_TRACE") or None,
+        manifest_path=os.environ.get("BENCH_RUN_MANIFEST") or None,
     )
     # Warmup: compile every jitted step on a one-window prefix with the
     # same static shapes as the main run. The step-fn cache makes the main
@@ -582,7 +586,12 @@ def device_leg(path: str) -> None:
         "phases": {k: round(v, 3) for k, v in s.phase_seconds.items()},
         "platform": platform,
     }
-    print(json.dumps({"gbs": s.gb_per_s, "info": info}))
+    from mapreduce_rust_tpu.runtime.telemetry import stats_to_dict
+
+    # The FULL JobStats rides back to the parent so the bench manifest
+    # carries every counter (wait split, wire bytes), not the info subset.
+    print(json.dumps({"gbs": s.gb_per_s, "info": info,
+                      "stats": stats_to_dict(s)}))
 
 
 def _run_device_leg(corpus: pathlib.Path, timeout_s: int, env: dict | None,
@@ -815,6 +824,7 @@ def main() -> None:
         result["zipf"] = zipf.get("zipf")
     if errors:
         result["error"] = "; ".join(errors)
+    _write_bench_manifest(result, dev, base_gbs)
     print(json.dumps(result))
     if dev:
         print(
@@ -824,7 +834,80 @@ def main() -> None:
         )
 
 
+def _write_bench_manifest(result: dict, dev, base_gbs) -> None:
+    """One manifest.json per bench run — config, platform, git rev, the
+    measured leg's full JobStats, probe outcomes, trace path — so BENCH
+    rounds read structured state instead of scraping log tails. Best
+    effort: a manifest failure must never cost the stdout JSON line."""
+    try:
+        from mapreduce_rust_tpu.runtime import telemetry
+
+        path = os.environ.get("BENCH_MANIFEST") or str(BENCH_DIR / "manifest.json")
+        bench_cfg = {
+            "target_mb": TARGET_MB, "baseline_mb": BASELINE_MB,
+            "fallback_mb": FALLBACK_MB,
+            "zipf_mb": int(os.environ.get("BENCH_ZIPF_MB", "256")),
+            "map_engine": os.environ.get("BENCH_MAP_ENGINE", "host"),
+            "device_timeout_s": DEVICE_TIMEOUT_S,
+            "probe_timeout_s": PROBE_TIMEOUT_S,
+        }
+        manifest = telemetry.build_manifest(
+            bench_cfg,
+            probes=result.get("probes"),
+            extra={
+                "kind": "bench_manifest",
+                "app": "word_count",
+                "result": result,
+                "cpu_baseline_gbs": round(base_gbs, 4) if base_gbs else None,
+                # NOT trace_path: every traced leg (median repeats, fallback,
+                # reprobe) rewrites the same trace + run-manifest files, so
+                # on disk they describe the LAST completed leg — which may
+                # not be the median-selected result above. The inner run
+                # manifest's own trace_path pairs correctly with its stats;
+                # point there instead of claiming the pairing here.
+                "last_leg_run_manifest": os.environ.get("BENCH_RUN_MANIFEST") or None,
+                "last_leg_trace": os.environ.get("BENCH_TRACE") or None,
+            },
+        )
+        if dev is not None and dev.get("stats"):
+            manifest["stats"] = dev["stats"]
+            manifest["phase_seconds"] = dev["info"].get("phases", {})
+        telemetry.write_manifest(path, manifest)
+        print(f"bench manifest: {path}", file=sys.stderr)
+    except Exception as e:
+        print(f"bench manifest write failed: {e!r}", file=sys.stderr)
+
+
+def _take_flag(argv: list, flag: str) -> str | None:
+    """Pop `flag VALUE` from argv (the legs' positional dispatch below must
+    not see it). Flag values travel to subprocess legs as env vars, which
+    both inherited and cpu_only_env child environments preserve."""
+    if flag in argv:
+        i = argv.index(flag)
+        if i + 1 >= len(argv):
+            raise SystemExit(f"{flag} needs a value")
+        v = argv[i + 1]
+        del argv[i:i + 2]
+        return v
+    return None
+
+
 if __name__ == "__main__":
+    _argv = sys.argv[1:]
+    _trace = _take_flag(_argv, "--trace")
+    if _trace:
+        os.environ["BENCH_TRACE"] = str(pathlib.Path(_trace).resolve())
+    _manifest = _take_flag(_argv, "--manifest")
+    if _manifest:
+        _mp = pathlib.Path(_manifest).resolve()
+        os.environ["BENCH_MANIFEST"] = str(_mp)
+        # The measured device-leg run also writes its OWN run manifest
+        # (full Config + JobStats from inside the subprocess), beside the
+        # bench-level one so the two never clobber each other.
+        os.environ.setdefault(
+            "BENCH_RUN_MANIFEST", str(_mp.with_name(_mp.stem + "-run.json"))
+        )
+    sys.argv = [sys.argv[0]] + _argv
     if len(sys.argv) > 1 and sys.argv[1] == "--device-leg":
         device_leg(sys.argv[2])
     elif len(sys.argv) > 1 and sys.argv[1] == "--micro":

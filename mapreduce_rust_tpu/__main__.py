@@ -43,6 +43,14 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--device", default="auto", choices=["auto", "tpu", "cpu"])
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace of the stream phase")
+    p.add_argument("--trace", default=None, metavar="PATH", dest="trace",
+                   help="write a Chrome trace-event JSON of the whole job "
+                   "(open in Perfetto / chrome://tracing); spans buffer in "
+                   "RAM and flush once at job end")
+    p.add_argument("--manifest", default=None, metavar="PATH", dest="manifest",
+                   help="write the machine-readable run manifest (config, "
+                   "platform, git rev, JobStats, phase times, trace path); "
+                   "inspect/diff with the `stats` subcommand")
     p.add_argument("-v", "--verbose", action="store_true")
 
 
@@ -61,6 +69,8 @@ def _cfg(args, map_n: int = 1, worker_n: int = 1) -> Config:
         host_accum_budget_mb=getattr(args, "accum_budget_mb", None),
         dictionary_budget_words=getattr(args, "dict_budget_words", None),
         profile_dir=args.profile_dir,
+        trace_path=getattr(args, "trace", None),
+        manifest_path=getattr(args, "manifest", None),
         host=args.host,
         port=args.port,
         input_dir=args.input,
@@ -146,6 +156,31 @@ def cmd_merge(args) -> int:
     return 0
 
 
+def cmd_stats(args) -> int:
+    """Pretty-print a run manifest — or, with a second path, diff two
+    (numeric fields with deltas): the BENCH round-over-round comparison
+    without scraping log tails."""
+    from mapreduce_rust_tpu.runtime.telemetry import (
+        diff_manifests,
+        format_manifest,
+        load_manifest,
+    )
+
+    a = load_manifest(args.manifest)
+    if args.other is None:
+        print(format_manifest(a))
+        return 0
+    b = load_manifest(args.other)
+    lines = diff_manifests(a, b)
+    if not lines:
+        print(f"{args.manifest} and {args.other}: no differences")
+        return 0
+    print(f"diff {args.manifest} -> {args.other}:")
+    for line in lines:
+        print(line)
+    return 0
+
+
 def cmd_clean(args) -> int:
     """Reference src/clean.sh:7-12: remove intermediates + outputs."""
     removed = 0
@@ -153,7 +188,8 @@ def cmd_clean(args) -> int:
     if os.path.exists(journal):
         os.remove(journal)
         removed += 1
-    for pattern in ("mr-*.npz", "dict-*", "driver.ckpt*"):
+    for pattern in ("mr-*.npz", "dict-*", "driver.ckpt*", "accrun-*",
+                    "dictrun-*", "job_report.json"):
         for p in glob.glob(os.path.join(args.work, pattern)):
             os.remove(p)
             removed += 1
@@ -219,10 +255,16 @@ def main(argv: list[str] | None = None) -> int:
     p = sub.add_parser("clean", help="remove intermediates and outputs")
     _add_common(p)
 
+    p = sub.add_parser("stats", help="pretty-print a run manifest, or diff two")
+    p.add_argument("manifest", help="manifest.json of a run")
+    p.add_argument("other", nargs="?", default=None,
+                   help="second manifest: print a field-level diff instead")
+    p.add_argument("-v", "--verbose", action="store_true")
+
     args = parser.parse_args(argv)
     args._parser = parser  # lets _app turn validation failures into usage errors
     logging.basicConfig(
-        level=logging.DEBUG if args.verbose else logging.INFO,
+        level=logging.DEBUG if getattr(args, "verbose", False) else logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
     return {
@@ -231,6 +273,7 @@ def main(argv: list[str] | None = None) -> int:
         "worker": cmd_worker,
         "merge": cmd_merge,
         "clean": cmd_clean,
+        "stats": cmd_stats,
     }[args.cmd](args)
 
 

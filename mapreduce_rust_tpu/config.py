@@ -75,6 +75,17 @@ class Config:
     profile_dir: Optional[str] = None  # write a jax.profiler trace of the
                                     # stream phase here (view with
                                     # tensorboard / xprof)
+    trace_path: Optional[str] = None  # write a Chrome trace-event JSON of
+                                    # the whole job here (open in Perfetto /
+                                    # chrome://tracing). Spans buffer in RAM
+                                    # and flush once at job end; overhead is
+                                    # per-chunk/per-round, never per-record
+                                    # (runtime/trace.py). Off by default.
+    manifest_path: Optional[str] = None  # write the machine-readable run
+                                    # manifest (config + platform + git rev
+                                    # + JobStats + phase times + trace path)
+                                    # here at job end; read/diff it with
+                                    # `python -m mapreduce_rust_tpu stats`
     compilation_cache_dir: Optional[str] = "auto"  # persistent XLA compile
                                     # cache shared across processes ("auto"
                                     # → <repo>/.jax_cache; None/"" disables).

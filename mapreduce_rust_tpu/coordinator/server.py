@@ -40,6 +40,8 @@ import os
 import time
 
 from mapreduce_rust_tpu.config import Config
+from mapreduce_rust_tpu.runtime.telemetry import JobReport, write_job_report
+from mapreduce_rust_tpu.runtime.trace import start_tracing, stop_tracing, trace_span
 
 log = logging.getLogger("mapreduce_rust_tpu.coordinator")
 
@@ -123,6 +125,11 @@ class Coordinator:
         self.map = _Phase(cfg.map_n, cfg.lease_timeout_s)
         self.reduce = _Phase(cfg.reduce_n, cfg.lease_timeout_s)
         self.worker_count = 0
+        # Control-plane telemetry: grants, renewals, expiries, re-executions
+        # and task durations per (phase, tid), plus RPC latencies — served
+        # over the `stats` RPC and dumped as work_dir/job_report.json at
+        # done(). Aggregate counters only (runtime/metrics.py doctrine).
+        self.report = JobReport()
         self._journal_path = os.path.join(cfg.work_dir, "coordinator.journal")
         if resume:
             self._replay_journal()
@@ -213,30 +220,48 @@ class Coordinator:
     def get_map_task(self) -> int:
         if not self.prepare():
             return NOT_READY  # registration barrier (coordinator.rs:142-144)
-        return self.map.grant()
+        tid = self.map.grant()
+        if tid >= 0:
+            self.report.record_grant("map", tid)
+        return tid
 
     def get_reduce_task(self) -> int:
         if not self.map.finished:
             return NOT_READY  # phase gate (coordinator.rs:183-185)
-        return self.reduce.grant()
+        tid = self.reduce.grant()
+        if tid >= 0:
+            self.report.record_grant("reduce", tid)
+        return tid
 
     def renew_map_lease(self, tid: int) -> bool:
-        return self.map.renew(tid)
+        ok = self.map.renew(tid)
+        self.report.record_renewal("map", tid, ok)
+        return ok
 
     def renew_reduce_lease(self, tid: int) -> bool:
-        return self.reduce.renew(tid)
+        ok = self.reduce.renew(tid)
+        self.report.record_renewal("reduce", tid, ok)
+        return ok
 
     def report_map_task_finish(self, tid: int) -> bool:
         done = self.map.report_finish(tid)
+        self.report.record_finish("map", tid)
         self._journal("map", tid)
         log.info("map %d finished (phase done=%s)", tid, done)
         return done
 
     def report_reduce_task_finish(self, tid: int) -> bool:
         done = self.reduce.report_finish(tid)
+        self.report.record_finish("reduce", tid)
         self._journal("reduce", tid)
         log.info("reduce %d finished (job done=%s)", tid, done)
         return done
+
+    def stats(self) -> dict:
+        """The 8th RPC: the live control-plane job report — task states,
+        re-executions, lease expiries, durations, RPC latencies. Plain
+        ints/floats, so it rides the same JSON transport as the sentinels."""
+        return self.report.to_dict()
 
     # ---- in-process methods (coordinator.rs:25-97) ----
 
@@ -249,6 +274,7 @@ class Coordinator:
     def check_lease(self) -> None:
         phase, name = (self.reduce, "reduce") if self.map.finished else (self.map, "map")
         for tid in phase.expire_stale():
+            self.report.record_expiry(name, tid)
             log.warning("%s task %d lease expired — rescheduling", name, tid)
 
     # ---- transport ----
@@ -257,6 +283,7 @@ class Coordinator:
         "get_worker_id", "get_map_task", "get_reduce_task",
         "renew_map_lease", "renew_reduce_lease",
         "report_map_task_finish", "report_reduce_task_finish",
+        "stats",
     })
 
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
@@ -270,7 +297,15 @@ class Coordinator:
                 if method not in self._METHODS:
                     resp = {"id": req.get("id"), "error": f"unknown method {method!r}"}
                 else:
-                    result = getattr(self, method)(*req.get("params", []))
+                    # Server-side RPC latency (dispatch + handler, excluding
+                    # socket writes): the coordinator-health number a stats
+                    # probe reads instead of timing its own round trips.
+                    # Per-RPC spans are control-plane rate (worker polls +
+                    # renewals), not data-plane rate — bounded, not per-record.
+                    t0 = time.perf_counter()
+                    with trace_span(f"rpc.{method}"):
+                        result = getattr(self, method)(*req.get("params", []))
+                    self.report.record_rpc(method, time.perf_counter() - t0)
                     resp = {"id": req.get("id"), "result": result}
                 writer.write(json.dumps(resp).encode() + b"\n")
                 await writer.drain()
@@ -283,6 +318,10 @@ class Coordinator:
         """Listen + poll loop: 1 Hz done() check, detector every
         lease_check_period_s (src/bin/mrcoordinator.rs:47-57). Returns when
         the job completes."""
+        # The coordinator honors Config.trace_path too: per-RPC spans (see
+        # _handle) make the control-plane timeline inspectable in Perfetto
+        # next to the workers' and driver's traces.
+        tracer = start_tracing() if self.cfg.trace_path else None
         server = await asyncio.start_server(self._handle, self.cfg.host, self.cfg.port)
         log.info("coordinator on %s:%d (map_n=%d reduce_n=%d worker_n=%d)",
                  self.cfg.host, self.cfg.port, self.cfg.map_n, self.cfg.reduce_n, self.cfg.worker_n)
@@ -293,8 +332,28 @@ class Coordinator:
                 if time.monotonic() - last_check >= self.cfg.lease_check_period_s:
                     self.check_lease()
                     last_check = time.monotonic()
+            # Job done: dump the control-plane report where a BENCH probe
+            # (or a human) finds structured state instead of log tails.
+            try:
+                path = write_job_report(
+                    os.path.join(self.cfg.work_dir, "job_report.json"), self.report
+                )
+                log.info("job report (%s) → %s", self.report.summary(), path)
+            except OSError as e:
+                log.warning("job report write failed: %s", e)
             log.info("job complete — results in %s/mr-*.txt", self.cfg.output_dir)
         finally:
+            if tracer is not None:
+                stop_tracing()
+            from mapreduce_rust_tpu.runtime.telemetry import flush_run_artifacts
+
+            flush_run_artifacts(
+                self.cfg, tracer, tag="coord", logger=log,
+                extra={
+                    "kind": "coordinator_manifest",
+                    "job_report": self.report.to_dict(),
+                },
+            )
             server.close()
             await server.wait_closed()
 

@@ -99,6 +99,15 @@ def merge_sorted_runs(a: KVBatch, b: KVBatch, by_value: bool = False) -> KVBatch
     ka = (a.k1, a.k2) + ((a.value,) if by_value else ())
     kb = (b.k1, b.k2) + ((b.value,) if by_value else ())
     na, nb = a.capacity, b.capacity
+    if na == 0:
+        # _searchsorted_right's h[mid] gathers clamp out-of-range indices,
+        # so an empty haystack would not crash — it would return garbage
+        # ranks and silently scramble the merge. Capacities are static
+        # under jit, so this is a trace-time check, free at runtime.
+        raise ValueError(
+            "merge_sorted_runs: haystack batch `a` has zero capacity — "
+            "the binary search cannot gather from an empty array"
+        )
     m = na + nb
     # Output position of b[j] = j + |a <= b[j]|; a bijection with the a
     # positions (standard stable two-way merge), and monotone in j.

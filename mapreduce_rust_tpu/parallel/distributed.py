@@ -46,6 +46,10 @@ def initialize(coordinator_address: str, num_processes: int, process_id: int,
     global _initialized
     if _initialized:
         return
+    import time
+
+    from mapreduce_rust_tpu.runtime.trace import trace_span
+
     import jax
 
     try:
@@ -53,19 +57,37 @@ def initialize(coordinator_address: str, num_processes: int, process_id: int,
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
     except (AttributeError, ValueError):
         pass
-    jax.distributed.initialize(
-        coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-        local_device_ids=local_device_ids,
-        **kwargs,
-    )
+    t0 = time.perf_counter()
+    with trace_span("distributed.initialize", coordinator=coordinator_address,
+                    process_id=process_id, num_processes=num_processes):
+        jax.distributed.initialize(
+            coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids,
+            **kwargs,
+        )
     _initialized = True
     log.info(
-        "joined distributed cluster %s as process %d/%d: %d global / %d local devices",
+        "joined distributed cluster %s as process %d/%d in %.2fs: "
+        "%d global / %d local devices",
         coordinator_address, process_id, num_processes,
+        time.perf_counter() - t0,
         jax.device_count(), jax.local_device_count(),
     )
+
+
+def cluster_info() -> dict:
+    """Manifest-ready identity of this process's view of the cluster."""
+    import jax
+
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "global_devices": jax.device_count(),
+        "local_devices": jax.local_device_count(),
+        "federated": is_federated(),
+    }
 
 
 def is_federated() -> bool:

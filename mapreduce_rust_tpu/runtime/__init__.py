@@ -1,6 +1,34 @@
-"""Runtime tier: host-side ingest, streaming driver, dictionary, metrics."""
+"""Runtime tier: host-side ingest, streaming driver, dictionary, metrics,
+trace/telemetry.
 
-from mapreduce_rust_tpu.runtime.chunker import Chunk, chunk_document, chunk_stream, iter_chunks, list_inputs  # noqa: F401
-from mapreduce_rust_tpu.runtime.dictionary import Dictionary, extract_words  # noqa: F401
-from mapreduce_rust_tpu.runtime.driver import JobResult, merge_outputs, run_job  # noqa: F401
-from mapreduce_rust_tpu.runtime.metrics import JobStats  # noqa: F401
+Re-exports are LAZY (PEP 562): importing a light submodule — say
+``runtime.telemetry`` from the coordinator's control-plane process — must
+not execute this package body eagerly pulling in ``runtime.driver`` and
+with it jax + an XLA backend. ``from mapreduce_rust_tpu.runtime import
+run_job`` still works; it just imports driver at attribute access time.
+"""
+
+_LAZY = {
+    "Chunk": "chunker", "chunk_document": "chunker", "chunk_stream": "chunker",
+    "iter_chunks": "chunker", "list_inputs": "chunker",
+    "Dictionary": "dictionary", "extract_words": "dictionary",
+    "JobResult": "driver", "merge_outputs": "driver", "run_job": "driver",
+    "JobStats": "metrics",
+    "JobReport": "telemetry", "build_manifest": "telemetry",
+    "diff_manifests": "telemetry", "load_manifest": "telemetry",
+    "write_manifest": "telemetry",
+    "Tracer": "trace", "trace_span": "trace", "validate_events": "trace",
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name: str):
+    submodule = _LAZY.get(name)
+    if submodule is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(
+        importlib.import_module(f"{__name__}.{submodule}"), name
+    )

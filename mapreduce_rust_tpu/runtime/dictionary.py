@@ -51,6 +51,30 @@ def extract_words(normalized: bytes) -> list[bytes]:
     return normalized.translate(None, _DELETE).split()
 
 
+def new_run_token() -> str:
+    """Per-instance spill-run filename token — THE shared naming policy of
+    both disk tiers (dictionary dictrun-* and accumulator accrun-*). pid
+    alone is NOT unique: two tiers in one process (back-to-back jobs
+    sharing a work_dir) or a stale crashed run's leftovers must never
+    collide on run names (ADVICE r5)."""
+    import uuid
+
+    return uuid.uuid4().hex[:8]
+
+
+def remove_run_files(runs: list) -> None:
+    """Delete spill run files and clear the list (job-end cleanup: runs
+    must not accumulate in a shared work_dir across jobs, ADVICE r5).
+    Idempotent; missing files are fine (another cleanup or `clean` got
+    there first)."""
+    for path in runs:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+    runs.clear()
+
+
 class Dictionary:
     """hash pair → word bytes, built incrementally at ingest.
 
@@ -89,16 +113,33 @@ class Dictionary:
         self._fresh_lens: list[int] = []
         self._runs: list[str] = []
         self._total_words = 0  # RAM + flushed distinct words
+        self._run_token = new_run_token()
 
     def __len__(self) -> int:
         return self._total_words
 
+    def _guard_ram_only(self, what: str) -> None:
+        """A budget flush moved words to disk runs: a RAM-tier point probe
+        would silently answer from a PARTIAL store (flushed words absent).
+        Raise instead — spilled dictionaries serve iter_sorted() only."""
+        if self._runs:
+            raise RuntimeError(
+                f"Dictionary.{what} after a budget flush would only see the "
+                "RAM tier (flushed words live in disk runs) — consume "
+                "iter_sorted() instead"
+            )
+
     def __contains__(self, key: tuple[int, int]) -> bool:
+        self._guard_ram_only("__contains__")
         return key in self._word_of
 
     @property
     def spilled(self) -> bool:
         return bool(self._runs)
+
+    @property
+    def run_count(self) -> int:
+        return len(self._runs)
 
     def lookup(self, k1: int, k2: int) -> bytes | None:
         """Point lookup — RAM-resident words only. A spilled dictionary
@@ -114,24 +155,34 @@ class Dictionary:
         the packed-key/length arrays for membership + collision probes."""
         if not self._word_of:
             return
+        from mapreduce_rust_tpu.runtime.trace import trace_span
+
         self._merge_fresh()
         os.makedirs(self.spill_dir, exist_ok=True)
         path = os.path.join(
-            self.spill_dir, f"dictrun-{os.getpid()}-{len(self._runs)}.txt"
+            self.spill_dir,
+            f"dictrun-{os.getpid()}-{self._run_token}-{len(self._runs)}.txt",
         )
         tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            for (k1, k2), w in sorted(
-                self._word_of.items(), key=lambda it: (it[0][0] << 32) | it[0][1]
-            ):
-                f.write(b"%d %d %s\n" % (k1, k2, w))
-        os.replace(tmp, path)
+        with trace_span("dictionary.flush", words=len(self._word_of),
+                        run=len(self._runs)):
+            with open(tmp, "wb") as f:
+                for (k1, k2), w in sorted(
+                    self._word_of.items(), key=lambda it: (it[0][0] << 32) | it[0][1]
+                ):
+                    f.write(b"%d %d %s\n" % (k1, k2, w))
+            os.replace(tmp, path)
         self._runs.append(path)
         self._word_of.clear()
         self._seen.clear()
         # Membership stays exact via _packed_sorted; the per-key dict would
         # otherwise grow unbounded alongside the words it indexes.
         self._len_of.clear()
+
+    def remove_runs(self) -> None:
+        """Job-end cleanup of this dictionary's spill run files (the driver
+        owns the lifecycle)."""
+        remove_run_files(self._runs)
 
     def _stored_len(self, packed: int) -> "int | None":
         """Stored word length for a packed key, or None if unseen — exact
@@ -345,8 +396,10 @@ class Dictionary:
         return self.add_scanned_raw(*res)
 
     def items(self) -> Iterator[tuple[tuple[int, int], bytes]]:
-        """RAM-resident entries only — spilled runs are served by
-        iter_sorted()."""
+        """RAM-resident entries; raises once any run has been flushed to
+        disk (a partial iteration would silently drop flushed words) —
+        spilled dictionaries are served whole by iter_sorted()."""
+        self._guard_ram_only("items")
         return iter(self._word_of.items())
 
     def iter_sorted(self) -> Iterator[tuple[int, int, int, bytes]]:

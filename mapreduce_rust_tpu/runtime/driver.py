@@ -67,8 +67,13 @@ from mapreduce_rust_tpu.ops.groupby import (
 )
 from mapreduce_rust_tpu.ops.tokenize import tokenize_and_hash
 from mapreduce_rust_tpu.runtime.chunker import chunk_stream, list_inputs
-from mapreduce_rust_tpu.runtime.dictionary import Dictionary
+from mapreduce_rust_tpu.runtime.dictionary import (
+    Dictionary,
+    new_run_token,
+    remove_run_files,
+)
 from mapreduce_rust_tpu.runtime.metrics import JobStats, log
+from mapreduce_rust_tpu.runtime.trace import start_tracing, stop_tracing, trace_span
 
 _cc_enabled = False
 
@@ -229,6 +234,7 @@ class HostAccumulator:
         self._pending_bytes = 0
         self._runs: list[str] = []          # sorted, deduped [n,3] .npy files
         self._table: dict | None = None
+        self._run_token = new_run_token()
 
     def add(self, keys: np.ndarray, vals: np.ndarray) -> None:
         keys = np.asarray(keys, dtype=np.int64).reshape(-1, 2)
@@ -248,6 +254,10 @@ class HostAccumulator:
     @property
     def has_runs(self) -> bool:
         return bool(self._runs)
+
+    @property
+    def run_count(self) -> int:
+        return len(self._runs)
 
     def _pending_rows(self) -> np.ndarray:
         """Combine the in-RAM pending batches into sorted deduped rows
@@ -275,18 +285,25 @@ class HostAccumulator:
         self._pending_bytes = 0
 
     def _flush_run(self) -> None:
-        rows = self._pending_rows()
-        self._clear_pending()
-        os.makedirs(self.spill_dir, exist_ok=True)
-        path = os.path.join(
-            self.spill_dir, f"accrun-{os.getpid()}-{len(self._runs)}.npy"
-        )
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            np.save(f, rows)
-        os.replace(tmp, path)
-        self._runs.append(path)
+        with trace_span("accumulator.flush_run", run=len(self._runs)):
+            rows = self._pending_rows()
+            self._clear_pending()
+            os.makedirs(self.spill_dir, exist_ok=True)
+            path = os.path.join(
+                self.spill_dir,
+                f"accrun-{os.getpid()}-{self._run_token}-{len(self._runs)}.npy",
+            )
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                np.save(f, rows)
+            os.replace(tmp, path)
+            self._runs.append(path)
         log.info("host accumulator: spilled run %d (%d rows)", len(self._runs), len(rows))
+
+    def remove_runs(self) -> None:
+        """Job-end cleanup of this accumulator's spill run files (the
+        driver owns the lifecycle — see dictionary.remove_run_files)."""
+        remove_run_files(self._runs)
 
     def _combine_sorted(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
         """Merge two sorted deduped [n,3] row arrays into one."""
@@ -506,7 +523,8 @@ class _IngestStream:
     def __iter__(self):
         while True:
             t0 = time.perf_counter()
-            chunk = self.q.get()
+            with trace_span("ingest.wait"):
+                chunk = self.q.get()
             self.stats.ingest_wait_s += time.perf_counter() - t0
             if chunk is _SENTINEL:
                 if self.err is not None:
@@ -564,12 +582,13 @@ def _stream_single(cfg: Config, app: App, inputs, stats, acc, dictionary,
         stats.partial_overflow_replays += 1
         if slow_fns is None:
             slow_fns = make_step_fns(app, cfg.chunk_bytes, use_pallas)
-        update, _ = slow_fns[0](jax.device_put(chunk_host, device), doc_id)
-        state, evicted, ev_count = slow_fns[1](state, update)
-        if int(ev_count) > 0:
-            stats.spill_events += 1
-            stats.spilled_keys += int(ev_count)
-            acc.add_batch(evicted)
+        with trace_span("chunk.replay"):
+            update, _ = slow_fns[0](jax.device_put(chunk_host, device), doc_id)
+            state, evicted, ev_count = slow_fns[1](state, update)
+            if int(ev_count) > 0:
+                stats.spill_events += 1
+                stats.spilled_keys += int(ev_count)
+                acc.add_batch(evicted)
 
     def drain(n: int) -> None:
         # Resolve the oldest n pipeline steps with ONE batched readback:
@@ -581,7 +600,8 @@ def _stream_single(cfg: Config, app: App, inputs, stats, acc, dictionary,
             return
         batch = [pending.popleft() for _ in range(n)]
         t0 = time.perf_counter()
-        flat = jax.device_get([x for (ovf, evc, *_rest) in batch for x in (ovf, evc)])
+        with trace_span("device.drain", steps=n):
+            flat = jax.device_get([x for (ovf, evc, *_rest) in batch for x in (ovf, evc)])
         stats.device_wait_s += time.perf_counter() - t0
         for (ovf, evc, evicted, chunk_host, did), ovf_n, ev_n in zip(
             batch, flat[::2], flat[1::2]
@@ -589,7 +609,8 @@ def _stream_single(cfg: Config, app: App, inputs, stats, acc, dictionary,
             if int(ev_n) > 0:
                 stats.spill_events += 1
                 stats.spilled_keys += int(ev_n)
-                acc.add_batch(evicted)
+                with trace_span("spill", keys=int(ev_n)):
+                    acc.add_batch(evicted)
             if int(ovf_n) > 0:
                 replay_chunk(chunk_host, did)
 
@@ -597,13 +618,15 @@ def _stream_single(cfg: Config, app: App, inputs, stats, acc, dictionary,
                            host_mask=app.host_mask)
     try:
         for chunk in ingest:
-            chunk_dev = jax.device_put(chunk.data, device)
-            did = jax.device_put(np.int32(chunk.doc_id), device)
-            update, ovf = map_combine(chunk_dev, did)
-            # Merge dispatches immediately — an overflowed update is empty
-            # on device, so merging before the flag reaches the host is safe.
-            state, evicted, ev_count = merge(state, update)
-            pending.append((ovf, ev_count, evicted, chunk.data, did))
+            with trace_span("chunk.dispatch"):
+                chunk_dev = jax.device_put(chunk.data, device)
+                did = jax.device_put(np.int32(chunk.doc_id), device)
+                update, ovf = map_combine(chunk_dev, did)
+                # Merge dispatches immediately — an overflowed update is
+                # empty on device, so merging before the flag reaches the
+                # host is safe.
+                state, evicted, ev_count = merge(state, update)
+                pending.append((ovf, ev_count, evicted, chunk.data, did))
             # Keep one window in flight while draining the previous one, so
             # the batched readback's round trip overlaps dispatched work.
             if len(pending) >= 2 * depth:
@@ -754,53 +777,57 @@ def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
             return
         batch = [pending.popleft() for _ in range(n)]
         t0 = time.perf_counter()
-        counts = jax.device_get([ev for ev, _ in batch])
+        with trace_span("device.drain", steps=n):
+            counts = jax.device_get([ev for ev, _ in batch])
         stats.device_wait_s += time.perf_counter() - t0
         for (ev, evicted), ev_n in zip(batch, counts):
             if int(ev_n) > 0:
                 stats.spill_events += 1
                 stats.spilled_keys += int(ev_n)
-                acc.add_batch(evicted)
+                with trace_span("spill", keys=int(ev_n)):
+                    acc.add_batch(evicted)
 
     def scan_window(item):
         doc_id, window = item
         t0 = time.perf_counter()
-        res = scan_count_raw(window)
-        if res is not None:
-            stats.host_map_s += time.perf_counter() - t0
-            return doc_id, "raw", res
-        out = doc_id, "py", _py_scan_count(window)
+        with trace_span("host_map.scan", doc=doc_id):
+            res = scan_count_raw(window)
+            if res is not None:
+                stats.host_map_s += time.perf_counter() - t0
+                return doc_id, "raw", res
+            out = doc_id, "py", _py_scan_count(window)
         stats.host_map_s += time.perf_counter() - t0
         return out
 
     def consume(result) -> None:
         nonlocal state
         t_glue = time.perf_counter()
-        doc_id, kind, res = result
-        stats.chunks += 1
-        if kind == "raw":
-            raw, ends, keys, counts = res
-            mask = app.host_mask(keys)
-            fold_scan_into_dictionary(dictionary, mask, "raw", (raw, ends, keys))
-        else:
-            words, keys, counts = res
-            mask = app.host_mask(keys)
-            fold_scan_into_dictionary(dictionary, mask, "list", (words, keys))
-        if mask is not None:  # filtering app (e.g. grep): keep query keys only
-            keys, counts = keys[mask], counts[mask]
-        values = app.host_values(counts, doc_id_offset + doc_id)
-        # Fixed update capacity, splitting big windows across merges: ONE
-        # compiled merge shape for the whole run (a variable cap means a
-        # ragged tail window triggers a fresh multi-10s XLA compile).
-        cap = cfg.host_update_cap
-        merge_packed = make_packed_merge_fn(app, cap)
-        for start in range(0, len(keys), cap):
-            flat = jax.device_put(
-                _pack_update(keys[start : start + cap], values[start : start + cap], cap),
-                device,
-            )
-            state, evicted, ev_count = merge_packed(state, flat)
-            pending.append((ev_count, evicted))
+        with trace_span("host_glue"):
+            doc_id, kind, res = result
+            stats.chunks += 1
+            if kind == "raw":
+                raw, ends, keys, counts = res
+                mask = app.host_mask(keys)
+                fold_scan_into_dictionary(dictionary, mask, "raw", (raw, ends, keys))
+            else:
+                words, keys, counts = res
+                mask = app.host_mask(keys)
+                fold_scan_into_dictionary(dictionary, mask, "list", (words, keys))
+            if mask is not None:  # filtering app (e.g. grep): query keys only
+                keys, counts = keys[mask], counts[mask]
+            values = app.host_values(counts, doc_id_offset + doc_id)
+            # Fixed update capacity, splitting big windows across merges: ONE
+            # compiled merge shape for the whole run (a variable cap means a
+            # ragged tail window triggers a fresh multi-10s XLA compile).
+            cap = cfg.host_update_cap
+            merge_packed = make_packed_merge_fn(app, cap)
+            for start in range(0, len(keys), cap):
+                flat = jax.device_put(
+                    _pack_update(keys[start : start + cap], values[start : start + cap], cap),
+                    device,
+                )
+                state, evicted, ev_count = merge_packed(state, flat)
+                pending.append((ev_count, evicted))
         # Glue stops before drain: drain's blocking readback is already
         # accounted in device_wait_s and must not be double-counted.
         stats.host_glue_s += time.perf_counter() - t_glue
@@ -991,21 +1018,24 @@ def _stream_multihost(cfg: Config, app: App, inputs, stats, acc, dictionary) -> 
         )
         stats.mesh_rounds += 1
         stats.shuffle_wire_bytes += wire_bytes_per_round(d, bucket_cap)
-        local, bad_p, bad_b = fast[0](chunks_g, docs_g)
-        state, evicted, ev_counts = fast[1](state, local)
-        flags = round_fn(
-            jax.make_array_from_process_local_data(
-                flag_shard, np.full(d_local, have, dtype=np.int32), global_shape=(d,)
+        with trace_span("mesh.all_to_all", round=stats.mesh_rounds, tier="fast",
+                        wire_bytes=wire_bytes_per_round(d, bucket_cap)):
+            local, bad_p, bad_b = fast[0](chunks_g, docs_g)
+            state, evicted, ev_counts = fast[1](state, local)
+            flags = round_fn(
+                jax.make_array_from_process_local_data(
+                    flag_shard, np.full(d_local, have, dtype=np.int32), global_shape=(d,)
+                )
             )
-        )
         # ONE batched fetch per round: the replicated flags (any local
         # shard holds the global value) AND this process's spill counts —
         # every separate blocking read is a full round trip.
         t0 = time.perf_counter()
-        got = jax.device_get(
-            [x.addressable_shards[0].data for x in (bad_p, bad_b, flags)]
-            + [s.data for s in ev_counts.addressable_shards]
-        )
+        with trace_span("device.drain", steps=1):
+            got = jax.device_get(
+                [x.addressable_shards[0].data for x in (bad_p, bad_b, flags)]
+                + [s.data for s in ev_counts.addressable_shards]
+            )
         stats.device_wait_s += time.perf_counter() - t0
         bad_p_l, bad_b_l, flags_l = got[:3]
         ev_local = np.concatenate([np.asarray(x).reshape(-1) for x in got[3:]])
@@ -1026,9 +1056,12 @@ def _stream_multihost(cfg: Config, app: App, inputs, stats, acc, dictionary) -> 
                 fns, tier_cap = tiers["skew"], u_cap
             stats.mesh_rounds += 1
             stats.shuffle_wire_bytes += wire_bytes_per_round(d, tier_cap)
-            local, _p, _b = fns[0](chunks_g, docs_g)
-            state, evicted2, ev2 = fns[1](state, local)
-            fold_local_spill(local_rows(ev2), evicted2)  # rare path: own fetch
+            with trace_span("mesh.all_to_all", round=stats.mesh_rounds,
+                            tier="replay",
+                            wire_bytes=wire_bytes_per_round(d, tier_cap)):
+                local, _p, _b = fns[0](chunks_g, docs_g)
+                state, evicted2, ev2 = fns[1](state, local)
+                fold_local_spill(local_rows(ev2), evicted2)  # rare: own fetch
         fold_local_spill(ev_local, evicted)
         return int(np.asarray(flags_l)[0]) > 0
 
@@ -1205,21 +1238,25 @@ def _stream_sharded(cfg: Config, app: App, inputs, stats, acc, dictionary) -> No
         stats.shuffle_wire_bytes += wire_bytes_per_round(
             d, cfg.max_word_len + shard_bytes + 1
         )
-        kv, _trunc = tokenize(shards)
-        local, _p, _b = wide["fns"](kv, docs)
-        state, evicted, ev_counts = wide["merge"](state, local)
-        ev_n = int(np.asarray(jax.device_get(ev_counts)).sum())
-        if ev_n > 0:
-            stats.spill_events += 1
-            stats.spilled_keys += ev_n
-            acc.add_batch(evicted)
+        with trace_span("mesh.all_to_all", round=stats.mesh_rounds, tier="replay",
+                        wire_bytes=wire_bytes_per_round(
+                            d, cfg.max_word_len + shard_bytes + 1)):
+            kv, _trunc = tokenize(shards)
+            local, _p, _b = wide["fns"](kv, docs)
+            state, evicted, ev_counts = wide["merge"](state, local)
+            ev_n = int(np.asarray(jax.device_get(ev_counts)).sum())
+            if ev_n > 0:
+                stats.spill_events += 1
+                stats.spilled_keys += ev_n
+                acc.add_batch(evicted)
 
     def drain(n: int) -> None:
         if n <= 0:
             return
         batch = [pending.popleft() for _ in range(n)]
         t0 = time.perf_counter()
-        flat = jax.device_get([x for row in batch for x in row[:4]])
+        with trace_span("device.drain", steps=n):
+            flat = jax.device_get([x for row in batch for x in row[:4]])
         stats.device_wait_s += time.perf_counter() - t0
         for row, trunc, p_ovf, b_ovf, ev in zip(
             batch, flat[::4], flat[1::4], flat[2::4], flat[3::4]
@@ -1229,7 +1266,8 @@ def _stream_sharded(cfg: Config, app: App, inputs, stats, acc, dictionary) -> No
             if ev_n > 0:
                 stats.spill_events += 1
                 stats.spilled_keys += ev_n
-                acc.add_batch(row[4])
+                with trace_span("spill", keys=ev_n):
+                    acc.add_batch(row[4])
             p_n = int(np.asarray(p_ovf).sum())
             b_n = int(np.asarray(b_ovf).sum())
             if p_n or b_n:
@@ -1264,16 +1302,21 @@ def _stream_sharded(cfg: Config, app: App, inputs, stats, acc, dictionary) -> No
                     end -= len(probe) - o
             group = norm[off:end]
             off = end
-            shards = jax.device_put(shard_stream(group, mesh, pad=shard_bytes), in_shard)
-            docs = jax.device_put(
-                np.full(d, doc_id, dtype=np.int32), rep
-            )
             stats.mesh_rounds += 1
             stats.shuffle_wire_bytes += wire_bytes_per_round(d, bucket_cap)
-            kv, trunc = tokenize(shards)
-            local, p_ovf, b_ovf = kv_shuffle(kv, docs)
-            state, evicted, ev_counts = merge(state, local)
-            pending.append((trunc, p_ovf, b_ovf, ev_counts, evicted, group, doc_id))
+            with trace_span("mesh.all_to_all", round=stats.mesh_rounds,
+                            tier="fast",
+                            wire_bytes=wire_bytes_per_round(d, bucket_cap)):
+                shards = jax.device_put(
+                    shard_stream(group, mesh, pad=shard_bytes), in_shard
+                )
+                docs = jax.device_put(
+                    np.full(d, doc_id, dtype=np.int32), rep
+                )
+                kv, trunc = tokenize(shards)
+                local, p_ovf, b_ovf = kv_shuffle(kv, docs)
+                state, evicted, ev_counts = merge(state, local)
+                pending.append((trunc, p_ovf, b_ovf, ev_counts, evicted, group, doc_id))
             if len(pending) >= 2 * depth:
                 drain(depth)
     drain(len(pending))
@@ -1346,13 +1389,15 @@ def _stream_mesh(cfg: Config, app: App, inputs, stats, acc, dictionary) -> None:
             fns, tier_cap = tiers["skew"], u_cap
         stats.mesh_rounds += 1
         stats.shuffle_wire_bytes += wire_bytes_per_round(d, tier_cap)
-        local, _, _ = fns[0](chunks_dev, docs_dev)
-        state, evicted, ev_counts = fns[1](state, local)
-        ev_n = int(np.asarray(jax.device_get(ev_counts)).sum())
-        if ev_n > 0:
-            stats.spill_events += 1
-            stats.spilled_keys += ev_n
-            acc.add_batch(evicted)
+        with trace_span("mesh.all_to_all", round=stats.mesh_rounds, tier="replay",
+                        wire_bytes=wire_bytes_per_round(d, tier_cap)):
+            local, _, _ = fns[0](chunks_dev, docs_dev)
+            state, evicted, ev_counts = fns[1](state, local)
+            ev_n = int(np.asarray(jax.device_get(ev_counts)).sum())
+            if ev_n > 0:
+                stats.spill_events += 1
+                stats.spilled_keys += ev_n
+                acc.add_batch(evicted)
 
     def drain(n: int) -> None:
         # One batched readback per window — see _stream_single.drain.
@@ -1360,9 +1405,10 @@ def _stream_mesh(cfg: Config, app: App, inputs, stats, acc, dictionary) -> None:
             return
         batch = [pending.popleft() for _ in range(n)]
         t0 = time.perf_counter()
-        flat = jax.device_get(
-            [x for (p, b, e, *_rest) in batch for x in (p, b, e)]
-        )
+        with trace_span("device.drain", steps=n):
+            flat = jax.device_get(
+                [x for (p, b, e, *_rest) in batch for x in (p, b, e)]
+            )
         stats.device_wait_s += time.perf_counter() - t0
         for (p, b, e, evicted, chunks_host, docs_host), p_arr, b_arr, e_arr in zip(
             batch, flat[::3], flat[1::3], flat[2::3]
@@ -1371,7 +1417,8 @@ def _stream_mesh(cfg: Config, app: App, inputs, stats, acc, dictionary) -> None:
             if ev_n > 0:
                 stats.spill_events += 1
                 stats.spilled_keys += ev_n
-                acc.add_batch(evicted)
+                with trace_span("spill", keys=ev_n):
+                    acc.add_batch(evicted)
             p_n = int(np.asarray(p_arr).sum())
             if p_n > 0 or int(np.asarray(b_arr).sum()) > 0:
                 replay_group(chunks_host, docs_host, p_n)
@@ -1390,14 +1437,16 @@ def _stream_mesh(cfg: Config, app: App, inputs, stats, acc, dictionary) -> None:
         group_docs.clear()
         stats.mesh_rounds += 1
         stats.shuffle_wire_bytes += wire_bytes_per_round(d, bucket_cap)
-        local, p_ovf, b_ovf = fast[0](
-            jax.device_put(chunks_host, in_shard), jax.device_put(docs_host, in_shard)
-        )
-        # Merge dispatches immediately — an overflowed group is empty on
-        # device, so merging before the flags reach the host is safe. Host
-        # arrays are kept for the rare replay instead of device buffers.
-        state, evicted, ev_counts = fast[1](state, local)
-        pending.append((p_ovf, b_ovf, ev_counts, evicted, chunks_host, docs_host))
+        with trace_span("mesh.all_to_all", round=stats.mesh_rounds, tier="fast",
+                        wire_bytes=wire_bytes_per_round(d, bucket_cap)):
+            local, p_ovf, b_ovf = fast[0](
+                jax.device_put(chunks_host, in_shard), jax.device_put(docs_host, in_shard)
+            )
+            # Merge dispatches immediately — an overflowed group is empty on
+            # device, so merging before the flags reach the host is safe.
+            # Host arrays are kept for the rare replay, not device buffers.
+            state, evicted, ev_counts = fast[1](state, local)
+            pending.append((p_ovf, b_ovf, ev_counts, evicted, chunks_host, docs_host))
         groups_done += 1
         if (
             cfg.checkpoint_every_groups > 0
@@ -1474,86 +1523,135 @@ def run_job(
     dictionary = Dictionary(
         budget_words=cfg.dictionary_budget_words, spill_dir=cfg.work_dir
     )
+    tracer = start_tracing() if cfg.trace_path else None
+    output_files: list[str] = []
+    table: dict = {}
 
     import contextlib
 
-    prof = (
-        jax.profiler.trace(cfg.profile_dir)
-        if cfg.profile_dir
-        else contextlib.nullcontext()
-    )
-    with stats.phase("stream"), prof:
-        if cfg.map_engine == "host" and cfg.mesh_shape and cfg.mesh_shape > 1:
+    try:
+        prof = (
+            jax.profiler.trace(cfg.profile_dir)
+            if cfg.profile_dir
+            else contextlib.nullcontext()
+        )
+        with stats.phase("stream"), prof:
+            if cfg.map_engine == "host" and cfg.mesh_shape and cfg.mesh_shape > 1:
+                log.warning(
+                    "map_engine='host' applies to the single-chip driver only; "
+                    "mesh runs tokenize on device (the mesh IS the map engine)"
+                )
+            if jax.process_count() > 1:
+                _stream_multihost(cfg, app, inputs, stats, acc, dictionary)
+            elif cfg.mesh_shape and cfg.mesh_shape > 1 and cfg.sharded_stream:
+                _stream_sharded(cfg, app, inputs, stats, acc, dictionary)
+            elif cfg.mesh_shape and cfg.mesh_shape > 1:
+                _stream_mesh(cfg, app, inputs, stats, acc, dictionary)
+            elif cfg.map_engine == "host":
+                _stream_host_map(cfg, app, inputs, stats, acc, dictionary)
+            else:
+                _stream_single(cfg, app, inputs, stats, acc, dictionary)
+
+        streaming = (acc.has_runs or dictionary.spilled) and type(app).finalize is App.finalize
+        if (acc.has_runs or dictionary.spilled) and not streaming:
             log.warning(
-                "map_engine='host' applies to the single-chip driver only; "
-                "mesh runs tokenize on device (the mesh IS the map engine)"
+                "app %s overrides finalize — rehydrating spilled egress tiers "
+                "into RAM (exact, but unbounded)", app.name
             )
-        if jax.process_count() > 1:
-            _stream_multihost(cfg, app, inputs, stats, acc, dictionary)
-        elif cfg.mesh_shape and cfg.mesh_shape > 1 and cfg.sharded_stream:
-            _stream_sharded(cfg, app, inputs, stats, acc, dictionary)
-        elif cfg.mesh_shape and cfg.mesh_shape > 1:
-            _stream_mesh(cfg, app, inputs, stats, acc, dictionary)
-        elif cfg.map_engine == "host":
-            _stream_host_map(cfg, app, inputs, stats, acc, dictionary)
+
+        if streaming:
+            # _stream_finalize opens its own finalize/egress phase blocks —
+            # nesting both here would double-count one interval under two keys.
+            output_files = _stream_finalize(
+                cfg, app, stats, acc, dictionary, write_outputs
+            )
         else:
-            _stream_single(cfg, app, inputs, stats, acc, dictionary)
+            with stats.phase("finalize"):
+                stats.distinct_keys = len(acc.table)
+                stats.dictionary_words = len(dictionary)
+                stats.hash_collisions = len(dictionary.collisions)
+                items = []
+                is_distinct = app.combine_op == "distinct"
+                lookup = dictionary.lookup
+                if dictionary.spilled:
+                    # Rehydrate fallback: serve point lookups from the full
+                    # sorted stream (runs + RAM) materialized once.
+                    full = {(k1, k2): w for _p, k1, k2, w in dictionary.iter_sorted()}
+                    lookup = lambda k1, k2: full.get((k1, k2))  # noqa: E731
+                for key, v in acc.table.items():
+                    word = lookup(*key)
+                    if word is None:
+                        stats.unknown_keys += 1
+                        continue
+                    value = sorted(v) if is_distinct else v
+                    items.append((word, value, key))
+                    table[word] = value
 
-    streaming = (acc.has_runs or dictionary.spilled) and type(app).finalize is App.finalize
-    if (acc.has_runs or dictionary.spilled) and not streaming:
-        log.warning(
-            "app %s overrides finalize — rehydrating spilled egress tiers "
-            "into RAM (exact, but unbounded)", app.name
-        )
+            with stats.phase("egress"):
+                parts = app.finalize(items, cfg.reduce_n)
+                if write_outputs:
+                    os.makedirs(cfg.output_dir, exist_ok=True)
+                    # Multi-process: each process emits ITS hash classes'
+                    # lines under a process-suffixed name; `merge` globs them
+                    # all (for top_k, App.merge_lines is the cross-process
+                    # selection root).
+                    suffix = f".p{jax.process_index()}" if jax.process_count() > 1 else ""
+                    for r in range(cfg.reduce_n):
+                        path = os.path.join(cfg.output_dir, f"mr-{r}{suffix}.txt")
+                        with open(path, "wb") as f:
+                            for line in parts.get(r, []):
+                                f.write(line + b"\n")
+                        output_files.append(path)
 
-    if streaming:
-        table = {}
-        # _stream_finalize opens its own finalize/egress phase blocks —
-        # nesting both here would double-count one interval under two keys.
-        output_files = _stream_finalize(
-            cfg, app, stats, acc, dictionary, write_outputs
-        )
-    else:
-        with stats.phase("finalize"):
-            stats.distinct_keys = len(acc.table)
-            stats.dictionary_words = len(dictionary)
-            stats.hash_collisions = len(dictionary.collisions)
-            items = []
-            table = {}
-            is_distinct = app.combine_op == "distinct"
-            lookup = dictionary.lookup
-            if dictionary.spilled:
-                # Rehydrate fallback: serve point lookups from the full
-                # sorted stream (runs + RAM) materialized once.
-                full = {(k1, k2): w for _p, k1, k2, w in dictionary.iter_sorted()}
-                lookup = lambda k1, k2: full.get((k1, k2))  # noqa: E731
-            for key, v in acc.table.items():
-                word = lookup(*key)
-                if word is None:
-                    stats.unknown_keys += 1
-                    continue
-                value = sorted(v) if is_distinct else v
-                items.append((word, value, key))
-                table[word] = value
+        stats.wall_seconds = time.perf_counter() - t0
+        log.info("job %s done: %s", app.name, stats.summary())
+    finally:
+        # Failure path still gets real wall time: the manifest is written
+        # even on a crash, and a 0.0-second crashed run would corrupt every
+        # post-mortem throughput comparison.
+        if not stats.wall_seconds:
+            stats.wall_seconds = time.perf_counter() - t0
+        # Spill runs are job-scoped scratch: a shared work_dir must not
+        # accumulate accrun-*/dictrun-* files across jobs (or leak them on
+        # a failed run) — ADVICE r5. Their counts survive in the stats (and
+        # manifest) as the proof the disk tiers engaged.
+        stats.accum_spill_runs = acc.run_count
+        stats.dict_spill_runs = dictionary.run_count
+        acc.remove_runs()
+        dictionary.remove_runs()
+        if tracer is not None:
+            stop_tracing()
+        if tracer is not None or cfg.manifest_path:
+            # Written even on failure (with an "error" field): a crashed
+            # run's manifest names what ran, which is the point. The whole
+            # block is best-effort — a telemetry failure (including a
+            # wedged distributed runtime below) must never mask the job's
+            # real exception.
+            import sys as _sys
 
-        output_files = []
-        with stats.phase("egress"):
-            parts = app.finalize(items, cfg.reduce_n)
-            if write_outputs:
-                os.makedirs(cfg.output_dir, exist_ok=True)
-                # Multi-process: each process emits ITS hash classes' lines
-                # under a process-suffixed name; `merge` globs them all (for
-                # top_k, App.merge_lines is the cross-process selection root).
-                suffix = f".p{jax.process_index()}" if jax.process_count() > 1 else ""
-                for r in range(cfg.reduce_n):
-                    path = os.path.join(cfg.output_dir, f"mr-{r}{suffix}.txt")
-                    with open(path, "wb") as f:
-                        for line in parts.get(r, []):
-                            f.write(line + b"\n")
-                    output_files.append(path)
+            from mapreduce_rust_tpu.runtime.telemetry import flush_run_artifacts
 
-    stats.wall_seconds = time.perf_counter() - t0
-    log.info("job %s done: %s", app.name, stats.summary())
+            exc = _sys.exc_info()[1]
+            extra: dict = {}
+            if exc is not None:
+                extra["error"] = repr(exc)
+            tag = None
+            try:
+                if jax.process_count() > 1:
+                    from mapreduce_rust_tpu.parallel.distributed import cluster_info
+
+                    extra["cluster"] = cluster_info()
+                    # Per-process file names, like the .p{rank} output
+                    # suffix above: co-hosted federated drivers must not
+                    # clobber each other's trace/manifest.
+                    tag = f"p{jax.process_index()}"
+            except Exception as e:
+                log.warning("cluster telemetry unavailable: %s", e)
+            flush_run_artifacts(
+                cfg, tracer, tag=tag, logger=log,
+                stats=stats, app_name=app.name, inputs=inputs,
+                output_files=output_files, extra=extra or None,
+            )
     return JobResult(stats=stats, table=table, output_files=output_files)
 
 
@@ -1593,36 +1691,42 @@ def _stream_finalize(cfg: Config, app: App, stats: JobStats, acc: HostAccumulato
     with stats.phase("egress"):
         os.makedirs(cfg.output_dir, exist_ok=True)
         tmpdir = tempfile.mkdtemp(prefix="egress-", dir=cfg.output_dir)
-        parts = [
-            open(os.path.join(tmpdir, f"part-{r}"), "wb") for r in range(cfg.reduce_n)
-        ]
-        matched = 0
+        # ONE try/finally spans the whole egress phase — the merge-join loop
+        # AND the per-partition sort/rewrite — so a failure anywhere in
+        # either (a bad run file, a full disk mid-sort) still removes the
+        # egress tmpdir instead of leaking part-* files into the output dir
+        # (ADVICE r5).
         try:
-            i = 0
-            packed_l = packed_rows  # numpy scalar compares are fine here
-            for packed, k1, _k2, word in dictionary.iter_sorted():
-                while i < n and int(packed_l[i]) < packed:
-                    i += 1  # fold key with no dictionary entry — counted below
-                if i >= n:
-                    break
-                if int(packed_l[i]) != packed:
-                    continue  # dictionary word absent from the fold (filtered)
-                j = i + 1
-                while j < n and packed_l[j] == packed_l[i]:
-                    j += 1
-                value = (
-                    sorted(rows[i:j, 2].tolist()) if is_distinct else int(rows[i, 2])
-                )
-                parts[k1 % cfg.reduce_n].write(app.format_line(word, value) + b"\n")
-                matched += 1
-                i = j
-        finally:
-            for f in parts:
-                f.close()
-        stats.unknown_keys = stats.distinct_keys - matched
+            parts = [
+                open(os.path.join(tmpdir, f"part-{r}"), "wb")
+                for r in range(cfg.reduce_n)
+            ]
+            matched = 0
+            try:
+                i = 0
+                packed_l = packed_rows  # numpy scalar compares are fine here
+                for packed, k1, _k2, word in dictionary.iter_sorted():
+                    while i < n and int(packed_l[i]) < packed:
+                        i += 1  # fold key with no dictionary entry — counted below
+                    if i >= n:
+                        break
+                    if int(packed_l[i]) != packed:
+                        continue  # dictionary word absent from the fold (filtered)
+                    j = i + 1
+                    while j < n and packed_l[j] == packed_l[i]:
+                        j += 1
+                    value = (
+                        sorted(rows[i:j, 2].tolist()) if is_distinct else int(rows[i, 2])
+                    )
+                    parts[k1 % cfg.reduce_n].write(app.format_line(word, value) + b"\n")
+                    matched += 1
+                    i = j
+            finally:
+                for f in parts:
+                    f.close()
+            stats.unknown_keys = stats.distinct_keys - matched
 
-        output_files: list[str] = []
-        try:
+            output_files: list[str] = []
             for r in range(cfg.reduce_n):
                 with open(os.path.join(tmpdir, f"part-{r}"), "rb") as f:
                     lines = f.read().splitlines()

@@ -14,6 +14,8 @@ import logging
 import time
 from contextlib import contextmanager
 
+from mapreduce_rust_tpu.runtime.trace import trace_span
+
 log = logging.getLogger("mapreduce_rust_tpu")
 
 
@@ -35,6 +37,12 @@ class JobStats:
     # records x 13 B (k1+k2+value+valid). This is what actually crosses the
     # interconnect (buckets are fixed-capacity under jit), so mesh runs can
     # attribute time to ICI vs compute before any multi-chip perf claim.
+    accum_spill_runs: int = 0     # accrun-* disk runs the accumulator's
+                                  # budget tier wrote (counted at job end,
+                                  # before the run files are deleted — the
+                                  # post-hoc proof the bounded-memory tier
+                                  # actually engaged)
+    dict_spill_runs: int = 0      # dictrun-* disk runs, same contract
     dictionary_words: int = 0
     hash_collisions: int = 0
     unknown_keys: int = 0         # final keys missing from the dictionary
@@ -70,7 +78,10 @@ class JobStats:
     def phase(self, name: str):
         t0 = time.perf_counter()
         try:
-            yield
+            # Phases double as top-level timeline spans ("phase.stream",
+            # "phase.finalize", "phase.egress") when tracing is on.
+            with trace_span(f"phase.{name}"):
+                yield
         finally:
             dt = time.perf_counter() - t0
             self.phase_seconds[name] = self.phase_seconds.get(name, 0.0) + dt

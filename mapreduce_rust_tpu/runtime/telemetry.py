@@ -1,0 +1,383 @@
+"""Control-plane telemetry + the machine-readable run manifest.
+
+Two consumers, one module:
+
+- **JobReport** — per-task control-plane accounting shared by the
+  coordinator and the worker: state transitions (grant → renew → finish),
+  lease expiries, re-executions (grants beyond the first), task durations,
+  and RPC latencies. The coordinator serves its report over the new
+  ``stats`` RPC and dumps it to ``{work_dir}/job_report.json`` when the
+  job completes, so a BENCH probe reads structured state instead of
+  re-reading stderr. Everything is plain ints/floats — JSON-serializable
+  by construction, like the RPC plane it describes.
+
+- **Run manifest** — one ``manifest.json`` per driver/bench run: config,
+  platform, git rev, the full ``JobStats`` (including the
+  ingest/device/host-map/host-glue wait split and ``shuffle_wire_bytes``),
+  phase times, trace path, probe outcomes. ``python -m mapreduce_rust_tpu
+  stats <manifest> [other]`` pretty-prints one or diffs two.
+
+No jax import at module level: the coordinator process must be able to
+build reports without dragging in a backend (same rule as runtime/trace).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+
+MANIFEST_SCHEMA = 1
+
+
+# ---------------------------------------------------------------------------
+# Control-plane job report
+# ---------------------------------------------------------------------------
+
+class JobReport:
+    """Per-task control-plane event log, aggregated — not per-RPC rows.
+
+    Counters only: each record_* call is a dict update on the (phase, tid)
+    slot, so a chatty renewal loop costs O(1) memory, in keeping with the
+    aggregate-counters doctrine of runtime/metrics.py.
+    """
+
+    def __init__(self) -> None:
+        self._tasks: dict[tuple[str, int], dict] = {}
+        self._rpc: dict[str, dict] = {}
+        self._t0 = time.monotonic()
+
+    def _task(self, phase: str, tid: int) -> dict:
+        t = self._tasks.get((phase, tid))
+        if t is None:
+            t = self._tasks[(phase, tid)] = {
+                "grants": 0,
+                "renewals": 0,
+                "stale_renewals": 0,
+                "expiries": 0,
+                "reports": 0,
+                "first_grant_s": None,
+                "done_s": None,
+            }
+        return t
+
+    def record_grant(self, phase: str, tid: int) -> None:
+        t = self._task(phase, tid)
+        t["grants"] += 1
+        if t["first_grant_s"] is None:
+            t["first_grant_s"] = time.monotonic() - self._t0
+
+    def record_renewal(self, phase: str, tid: int, ok: bool) -> None:
+        # Update-only: a renewal for a task this incarnation never granted
+        # (a surviving worker's lease after a journal-resume restart) must
+        # not fabricate a grants=0/incomplete phantom entry in the report.
+        t = self._tasks.get((phase, tid))
+        if t is not None:
+            t["renewals" if ok else "stale_renewals"] += 1
+
+    def record_expiry(self, phase: str, tid: int) -> None:
+        self._task(phase, tid)["expiries"] += 1
+
+    def record_finish(self, phase: str, tid: int) -> None:
+        # Update-only, like record_renewal: a finish report for a task this
+        # incarnation never granted (journal-resume restart) must not
+        # fabricate a completed-but-never-granted entry whose duration_s
+        # would be null.
+        t = self._tasks.get((phase, tid))
+        if t is None:
+            return
+        t["reports"] += 1
+        if t["done_s"] is None:
+            t["done_s"] = time.monotonic() - self._t0
+
+    def in_flight(self) -> list[tuple[str, int]]:
+        """(phase, tid) of tasks granted but not yet reported finished —
+        i.e. leases currently held, as this side observed them."""
+        return [
+            key for key, t in self._tasks.items()
+            if t["grants"] > 0 and t["done_s"] is None
+        ]
+
+    def record_rpc(self, method: str, seconds: float) -> None:
+        r = self._rpc.get(method)
+        if r is None:
+            r = self._rpc[method] = {"count": 0, "total_s": 0.0, "max_s": 0.0}
+        r["count"] += 1
+        r["total_s"] += seconds
+        r["max_s"] = max(r["max_s"], seconds)
+
+    def to_dict(self) -> dict:
+        phases: dict[str, dict] = {}
+        for (phase, tid), t in sorted(self._tasks.items()):
+            duration = (
+                round(t["done_s"] - t["first_grant_s"], 6)
+                if t["done_s"] is not None and t["first_grant_s"] is not None
+                else None
+            )
+            phases.setdefault(phase, {})[str(tid)] = {
+                "grants": t["grants"],
+                "re_executions": max(t["grants"] - 1, 0),
+                "expiries": t["expiries"],
+                "renewals": t["renewals"],
+                "stale_renewals": t["stale_renewals"],
+                "reports": t["reports"],
+                "duration_s": duration,
+                "completed": t["done_s"] is not None,
+            }
+        totals = {
+            phase: {
+                "tasks": len(tasks),
+                "completed": sum(1 for t in tasks.values() if t["completed"]),
+                "re_executions": sum(t["re_executions"] for t in tasks.values()),
+                "expiries": sum(t["expiries"] for t in tasks.values()),
+            }
+            for phase, tasks in phases.items()
+        }
+        rpc = {
+            m: {
+                "count": r["count"],
+                "total_s": round(r["total_s"], 6),
+                "mean_ms": round(r["total_s"] / r["count"] * 1e3, 3),
+                "max_ms": round(r["max_s"] * 1e3, 3),
+            }
+            for m, r in sorted(self._rpc.items())
+        }
+        return {"tasks": phases, "totals": totals, "rpc": rpc}
+
+    def summary(self) -> str:
+        d = self.to_dict()
+        parts = []
+        for phase, tot in d["totals"].items():
+            parts.append(
+                f"{phase}: {tot['completed']}/{tot['tasks']} done, "
+                f"{tot['expiries']} expiries, {tot['re_executions']} re-execs"
+            )
+        n_rpc = sum(r["count"] for r in d["rpc"].values())
+        parts.append(f"{n_rpc} RPCs")
+        return "; ".join(parts)
+
+
+def write_job_report(path: str, report: JobReport) -> str:
+    return write_manifest(path, {
+        "schema": MANIFEST_SCHEMA,
+        "kind": "job_report",
+        "created": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "report": report.to_dict(),
+    })
+
+
+# ---------------------------------------------------------------------------
+# Run manifest
+# ---------------------------------------------------------------------------
+
+def git_rev(repo_dir: str | None = None) -> str | None:
+    """Current commit hash, or None outside a repo / without git."""
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=repo_dir or os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))),
+            capture_output=True, text=True, timeout=5,
+        )
+        return out.stdout.strip() or None if out.returncode == 0 else None
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def platform_info() -> dict:
+    """Host + (when already imported) jax/device identity. Never imports
+    jax itself: a control-plane manifest must not initialize a backend."""
+    import platform as _platform
+
+    info: dict = {
+        "python": sys.version.split()[0],
+        "machine": _platform.machine(),
+        "system": _platform.system(),
+        "hostname": _platform.node(),
+        "pid": os.getpid(),
+    }
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        info["jax"] = jax.__version__
+        try:
+            devs = jax.devices()
+            info["backend"] = devs[0].platform
+            info["device_count"] = len(devs)
+            info["process_count"] = jax.process_count()
+        except Exception:  # backend init failed — manifest still writes
+            info["backend"] = "unavailable"
+    return info
+
+
+def stats_to_dict(stats) -> dict:
+    """Every JobStats field (the full dataclass — including the
+    ingest/device/host-map/host-glue wait split and shuffle_wire_bytes)
+    plus the derived properties."""
+    d = dataclasses.asdict(stats)
+    d["gb_per_s"] = stats.gb_per_s
+    d["bottleneck"] = stats.bottleneck
+    return d
+
+
+def build_manifest(cfg, stats=None, app_name: str | None = None,
+                   inputs=None, output_files=None, trace_path: str | None = None,
+                   probes=None, extra: dict | None = None) -> dict:
+    """Assemble one run's manifest dict. ``cfg`` may be a Config (asdict'd)
+    or a plain dict (bench harness config); everything else is optional so
+    partial failures still produce a manifest naming what ran."""
+    m: dict = {
+        "schema": MANIFEST_SCHEMA,
+        "kind": "run_manifest",
+        "created": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "git_rev": git_rev(),
+        "platform": platform_info(),
+        "argv": list(sys.argv),
+    }
+    if cfg is not None:
+        m["config"] = cfg if isinstance(cfg, dict) else dataclasses.asdict(cfg)
+    if app_name is not None:
+        m["app"] = app_name
+    if inputs is not None:
+        m["inputs"] = [str(p) for p in inputs]
+    if output_files is not None:
+        m["output_files"] = [str(p) for p in output_files]
+    if stats is not None:
+        m["stats"] = stats_to_dict(stats)
+        m["phase_seconds"] = dict(stats.phase_seconds)
+    if trace_path is not None:
+        m["trace_path"] = os.path.abspath(trace_path)
+    if probes is not None:
+        m["probes"] = probes
+    if extra:
+        m.update(extra)
+    return m
+
+
+def write_manifest(path: str, manifest: dict) -> str:
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+def load_manifest(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def flush_run_artifacts(cfg, tracer=None, tag: str | None = None,
+                        logger=None, **manifest_fields) -> str | None:
+    """End-of-run teardown shared by the driver, worker and coordinator:
+    write the tracer's buffer to ``cfg.trace_path`` and a manifest to
+    ``cfg.manifest_path`` (both suffixed per-process when ``tag`` is given
+    — co-hosted processes must never clobber each other's files). Strictly
+    best-effort: nothing here may raise, or telemetry would mask the run's
+    real outcome. Returns the trace file path (or None)."""
+    from mapreduce_rust_tpu.runtime.trace import per_process_path
+
+    trace_file = None
+    if tracer is not None and cfg.trace_path:
+        try:
+            path = per_process_path(cfg.trace_path, tag) if tag else cfg.trace_path
+            trace_file = tracer.write(path)
+            if logger:
+                logger.info("trace: %d spans → %s", len(tracer), trace_file)
+        except Exception as e:
+            if logger:
+                logger.warning("trace write failed: %s", e)
+    if cfg.manifest_path:
+        try:
+            path = (
+                per_process_path(cfg.manifest_path, tag) if tag
+                else cfg.manifest_path
+            )
+            write_manifest(path, build_manifest(
+                cfg, trace_path=trace_file, **manifest_fields
+            ))
+            if logger:
+                logger.info("manifest → %s", path)
+        except Exception as e:
+            if logger:
+                logger.warning("manifest write failed: %s", e)
+    return trace_file
+
+
+def _flatten(d: dict, prefix: str = "") -> dict:
+    out: dict = {}
+    for k, v in d.items():
+        key = f"{prefix}.{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            out.update(_flatten(v, key))
+        else:
+            out[key] = v
+    return out
+
+
+def format_manifest(m: dict) -> str:
+    """Human view of one manifest: identity header, then the stats that
+    decide a BENCH verdict, then phase times."""
+    lines = [
+        f"run manifest (schema {m.get('schema')}) — {m.get('created')}",
+        f"  app: {m.get('app', '?')}  git: {str(m.get('git_rev'))[:12]}",
+    ]
+    p = m.get("platform", {})
+    lines.append(
+        f"  platform: {p.get('backend', 'none')} x{p.get('device_count', '?')} "
+        f"jax={p.get('jax', '-')} python={p.get('python', '?')} ({p.get('machine', '?')})"
+    )
+    s = m.get("stats")
+    if s:
+        lines.append(
+            f"  {s['bytes_in'] / 1e6:.2f} MB in {s['wall_seconds']:.3f}s "
+            f"({s['gb_per_s']:.4f} GB/s) — bottleneck: {s['bottleneck']}"
+        )
+        lines.append(
+            f"  distinct={s['distinct_keys']} chunks={s['chunks']} "
+            f"spills={s['spill_events']}({s['spilled_keys']} keys) "
+            f"replays={s['partial_overflow_replays']}+{s['bucket_skew_replays']}skew "
+            f"collisions={s['hash_collisions']} unknown={s['unknown_keys']}"
+        )
+        lines.append(
+            f"  shuffle: {s['mesh_rounds']} rounds, "
+            f"{s['shuffle_wire_bytes'] / 1e6:.1f} MB wire"
+        )
+        lines.append(
+            f"  waits: ingest={s['ingest_wait_s']:.3f}s device={s['device_wait_s']:.3f}s "
+            f"host_map={s['host_map_s']:.3f}s host_glue={s['host_glue_s']:.3f}s"
+        )
+    for name, secs in (m.get("phase_seconds") or {}).items():
+        lines.append(f"  phase {name:<10} {secs:8.3f}s")
+    if m.get("trace_path"):
+        lines.append(f"  trace: {m['trace_path']}")
+    for probe in m.get("probes") or []:
+        status = "ok" if probe.get("ok") else f"FAILED ({probe.get('error', '?')})"
+        lines.append(f"  probe {probe.get('leg', '?'):<14} {status}")
+    return "\n".join(lines)
+
+
+def diff_manifests(a: dict, b: dict) -> list[str]:
+    """Field-level diff of two manifests, numeric fields with deltas —
+    the BENCH round-over-round comparison, machine-checkable."""
+    fa, fb = _flatten(a), _flatten(b)
+    skip = ("created", "argv", "platform.pid", "platform.hostname")
+    lines = []
+    for key in sorted(set(fa) | set(fb)):
+        if key.startswith(skip) or key in skip:
+            continue
+        va, vb = fa.get(key, "<absent>"), fb.get(key, "<absent>")
+        if va == vb:
+            continue
+        if isinstance(va, (int, float)) and isinstance(vb, (int, float)) \
+                and not isinstance(va, bool) and not isinstance(vb, bool):
+            delta = vb - va
+            rel = f" ({delta / va:+.1%})" if va else ""
+            lines.append(f"  {key}: {va} -> {vb} [{delta:+g}{rel}]")
+        else:
+            lines.append(f"  {key}: {va!r} -> {vb!r}")
+    return lines

@@ -1,0 +1,209 @@
+"""In-process timeline tracer — Chrome trace-event JSON, buffered in RAM.
+
+The observability doctrine (runtime/metrics.py) forbids per-record work on
+the hot path; this tracer keeps that contract at the SPAN level: a span is
+two ``perf_counter`` reads plus one list append (lists append GIL-atomically,
+so producer/scan/consumer threads share one buffer lock-free), and the whole
+buffer is serialized exactly once, at job end. Per-chunk and per-round spans
+are fine; per-record spans are not.
+
+Output is the Chrome trace-event format — ``{"traceEvents": [...]}`` of
+"X" (complete) events with microsecond ``ts``/``dur`` — loadable directly
+in Perfetto (https://ui.perfetto.dev) or ``chrome://tracing``. Spans on one
+thread nest by call structure, so the viewer reconstructs the flame graph
+with no explicit parent links.
+
+When JAX is already imported, every span also enters a
+``jax.profiler.TraceAnnotation``: a ``Config.profile_dir`` XLA trace taken
+in the same run then shows these host spans on the profiler timeline,
+lined up with the device ops they dispatched. The import is lazy AND
+conditional on ``jax`` being in ``sys.modules`` — control-plane processes
+(coordinator) must be able to trace without dragging in a backend.
+
+Tracing is OFF by default: ``trace_span`` with no active tracer is a
+single global read. ``run_job`` activates a tracer when
+``Config.trace_path`` is set and writes the file in its ``finally``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+
+_UNSET = object()  # undecided; None = permanently unavailable; else the class
+_ANN = _UNSET
+
+_tracer: "Tracer | None" = None
+
+
+def _annotation_cls():
+    """jax.profiler.TraceAnnotation iff jax is ALREADY imported, else None.
+
+    Three cache states: undecided (_UNSET — jax not seen yet, re-check so a
+    later jax import is picked up), permanently unavailable (None — the
+    profiler import FAILED once; never re-attempt it on the span hot path),
+    or the class. A jax-free process stays undecided forever, cheaply
+    (one sys.modules probe per span).
+    """
+    global _ANN
+    if _ANN is _UNSET:
+        import sys
+
+        if "jax" not in sys.modules:
+            return None  # undecided: don't force a backend into this process
+        try:
+            from jax.profiler import TraceAnnotation
+
+            _ANN = TraceAnnotation
+        except Exception:  # profiler API moved/absent — spans still record
+            _ANN = None
+    return _ANN
+
+
+class Tracer:
+    """Bounded-overhead span buffer for one run.
+
+    Events are (name, t0, t1, thread_id, args) tuples; timestamps are raw
+    ``perf_counter`` seconds, rebased to the tracer's epoch only at
+    ``write`` time so the hot path does no arithmetic beyond the clock
+    reads themselves.
+    """
+
+    def __init__(self) -> None:
+        self._epoch = time.perf_counter()
+        self._pid = os.getpid()
+        self._events: list[tuple] = []  # append is GIL-atomic
+
+    def add_span(self, name: str, t0: float, t1: float, args=None) -> None:
+        self._events.append((name, t0, t1, threading.get_ident(), args))
+
+    def instant(self, name: str, **args) -> None:
+        t = time.perf_counter()
+        self._events.append((name, t, None, threading.get_ident(), args or None))
+
+    def __len__(self) -> int:
+        return len(self._events)
+
+    def events(self) -> list[dict]:
+        """The buffer as Chrome trace-event dicts (µs since the epoch)."""
+        out = []
+        for name, t0, t1, tid, args in self._events:
+            ev = {
+                "name": name,
+                "ph": "X" if t1 is not None else "i",
+                "ts": (t0 - self._epoch) * 1e6,
+                "pid": self._pid,
+                "tid": tid,
+            }
+            if t1 is not None:
+                ev["dur"] = (t1 - t0) * 1e6
+            else:
+                ev["s"] = "t"  # instant event scope: thread
+            if args:
+                ev["args"] = {k: v for k, v in args.items()}
+            out.append(ev)
+        return out
+
+    def write(self, path: str) -> str:
+        """Serialize once, atomically (tmp + rename). Returns ``path``."""
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        tmp = f"{path}.{self._pid}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {"traceEvents": self.events(), "displayTimeUnit": "ms"},
+                f,
+                separators=(",", ":"),
+            )
+        os.replace(tmp, path)
+        return path
+
+
+def start_tracing() -> Tracer:
+    """Install a fresh process-global tracer (one tracer per run: run_job
+    owns the lifecycle; concurrent run_jobs in one process would interleave
+    buffers, which the driver does not do)."""
+    global _tracer
+    _tracer = Tracer()
+    return _tracer
+
+
+def stop_tracing() -> "Tracer | None":
+    """Deactivate and return the current tracer (caller writes it)."""
+    global _tracer
+    t, _tracer = _tracer, None
+    return t
+
+
+def active_tracer() -> "Tracer | None":
+    return _tracer
+
+
+@contextmanager
+def trace_span(name: str, **args):
+    """Span context: no-op (one global read) when tracing is off.
+
+    With a tracer active, also enters a ``jax.profiler.TraceAnnotation`` so
+    an XLA profile of the same interval shows this span on its timeline.
+    """
+    tr = _tracer
+    if tr is None:
+        yield
+        return
+    ann_cls = _annotation_cls()
+    ann = ann_cls(name) if ann_cls is not None else None
+    if ann is not None:
+        ann.__enter__()
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        t1 = time.perf_counter()
+        if ann is not None:
+            ann.__exit__(None, None, None)
+        tr.add_span(name, t0, t1, args or None)
+
+
+def per_process_path(path: str, tag: str) -> str:
+    """Derive a per-process artifact path (`x.json` → `x-w123.json`):
+    several workers (or a coordinator) on one host may share a Config, and
+    their trace/manifest files must never clobber each other."""
+    root, ext = os.path.splitext(path)
+    return f"{root}-{tag}{ext or '.json'}"
+
+
+def validate_events(events: list[dict]) -> None:
+    """Structural validator for a Chrome trace-event list (the test and
+    ``stats`` consumers share it): required fields, and per-(pid, tid)
+    "X" spans either nest or are disjoint — never partially overlap, which
+    is what makes the Perfetto flame graph well-formed.
+    """
+    per_thread: dict = {}
+    for ev in events:
+        for field in ("name", "ph", "ts", "pid", "tid"):
+            if field not in ev:
+                raise ValueError(f"event missing {field!r}: {ev}")
+        if ev["ph"] == "X":
+            if "dur" not in ev or ev["dur"] < 0:
+                raise ValueError(f"X event needs dur >= 0: {ev}")
+            per_thread.setdefault((ev["pid"], ev["tid"]), []).append(
+                (ev["ts"], ev["ts"] + ev["dur"], ev["name"])
+            )
+    for key, spans in per_thread.items():
+        # Sort by start asc, end desc: a containing span precedes its
+        # children, so a stack check catches partial overlap.
+        spans.sort(key=lambda s: (s[0], -s[1]))
+        stack: list[tuple] = []
+        for s0, s1, name in spans:
+            while stack and stack[-1][1] <= s0:
+                stack.pop()
+            if stack and s1 > stack[-1][1]:
+                raise ValueError(
+                    f"span {name!r} [{s0}, {s1}] partially overlaps "
+                    f"{stack[-1][2]!r} [{stack[-1][0]}, {stack[-1][1]}] "
+                    f"on thread {key}"
+                )
+            stack.append((s0, s1, name))

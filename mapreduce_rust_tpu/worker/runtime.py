@@ -33,6 +33,7 @@ import collections
 import logging
 import os
 import pathlib
+import time
 import uuid
 
 import numpy as np
@@ -44,6 +45,8 @@ from mapreduce_rust_tpu.coordinator.server import DONE, NOT_READY, WAIT, Coordin
 from mapreduce_rust_tpu.core.hashing import hash_words
 from mapreduce_rust_tpu.runtime.chunker import chunk_stream, list_inputs
 from mapreduce_rust_tpu.runtime.dictionary import Dictionary, extract_words
+from mapreduce_rust_tpu.runtime.telemetry import JobReport
+from mapreduce_rust_tpu.runtime.trace import start_tracing, stop_tracing, trace_span
 
 log = logging.getLogger("mapreduce_rust_tpu.worker")
 
@@ -76,6 +79,12 @@ class Worker:
         self.work = pathlib.Path(cfg.work_dir)
         self.out = pathlib.Path(cfg.output_dir)
         self.worker_id: int | None = None
+        # Worker-side control-plane telemetry, symmetric with the
+        # coordinator's: tasks this worker ran (grant → finish, durations)
+        # and CLIENT-observed RPC latencies — which include the network and
+        # the coordinator's event loop, so comparing against the server-side
+        # numbers in the `stats` RPC isolates where a slow RPC spends.
+        self.report = JobReport()
 
     # ---- map/reduce engines ----
 
@@ -157,6 +166,10 @@ class Worker:
         return acc.table, dictionary
 
     def run_map_task(self, tid: int) -> None:
+        with trace_span("worker.map_task", tid=tid):
+            self._run_map_task(tid)
+
+    def _run_map_task(self, tid: int) -> None:
         path = self.inputs[tid]
         table, dictionary = self._map_table(tid, path)
         self.work.mkdir(parents=True, exist_ok=True)
@@ -189,6 +202,10 @@ class Worker:
         log.info("map %d: %s → %d keys, %d dict words", tid, path, len(table), len(dictionary))
 
     def run_reduce_task(self, tid: int) -> None:
+        with trace_span("worker.reduce_task", tid=tid):
+            self._run_reduce_task(tid)
+
+    def _run_reduce_task(self, tid: int) -> None:
         from mapreduce_rust_tpu.runtime.driver import HostAccumulator
 
         acc = HostAccumulator(self.app.combine_op)
@@ -217,20 +234,35 @@ class Worker:
 
     # ---- task loop ----
 
+    async def _call(self, client: CoordinatorClient, method: str, *params):
+        """client.call with the round-trip latency recorded (client-observed:
+        network + coordinator event loop + handler)."""
+        t0 = time.perf_counter()
+        try:
+            return await client.call(method, *params)
+        finally:
+            self.report.record_rpc(method, time.perf_counter() - t0)
+
+    def _phase_name(self, method: str) -> str:
+        return "map" if "map" in method else "reduce"
+
     async def _renewal_loop(self, client: CoordinatorClient, method: str, tid: int) -> None:
         try:
             while True:
                 await asyncio.sleep(self.cfg.lease_renew_period_s)
-                if not await client.call(method, tid):
+                ok = await self._call(client, method, tid)
+                self.report.record_renewal(self._phase_name(method), tid, bool(ok))
+                if not ok:
                     return  # stale lease (already reported) — just stop
         except (asyncio.CancelledError, ConnectionResetError):
             pass
 
     async def _run_phase(self, client: CoordinatorClient, get: str, renew: str,
                          report: str, run_task) -> None:
+        phase = self._phase_name(get)
         while True:
             try:
-                tid = await client.call(get)
+                tid = await self._call(client, get)
             except ConnectionError:
                 # Coordinator exited between our WAIT poll and this call —
                 # the job completed while we slept. A clean end, not a crash.
@@ -243,6 +275,7 @@ class Worker:
             if tid in (NOT_READY, WAIT):
                 await asyncio.sleep(self.cfg.poll_retry_s)
                 continue
+            self.report.record_grant(phase, tid)
             # Separate connection for renewals, like the reference's
             # spawned renewal task (mrworker.rs:70-94) — but paced.
             renew_client = CoordinatorClient(self.cfg.host, self.cfg.port)
@@ -255,9 +288,13 @@ class Worker:
                 renewal.cancel()
                 await asyncio.gather(renewal, return_exceptions=True)
                 await renew_client.close()
-            await client.call(report, tid)
+            await self._call(client, report, tid)
+            self.report.record_finish(phase, tid)
 
     async def run(self) -> None:
+        # The worker honors Config.trace_path/manifest_path like the driver
+        # does, under per-process names (several workers share one Config).
+        tracer = start_tracing() if self.cfg.trace_path else None
         client = CoordinatorClient(self.cfg.host, self.cfg.port)
         await client.connect()
         try:
@@ -272,6 +309,19 @@ class Worker:
             log.info("worker %d: reduce phase", wid)
             await self._run_phase(client, "get_reduce_task", "renew_reduce_lease",
                                   "report_reduce_task_finish", self.run_reduce_task)
-            log.info("worker %d: done", wid)
+            log.info("worker %d: done (%s)", wid, self.report.summary())
         finally:
             await client.close()
+            if tracer is not None:
+                stop_tracing()
+            from mapreduce_rust_tpu.runtime.telemetry import flush_run_artifacts
+
+            flush_run_artifacts(
+                self.cfg, tracer, tag=f"w{os.getpid()}", logger=log,
+                extra={
+                    "kind": "worker_manifest",
+                    "worker_id": self.worker_id,
+                    "engine": self.engine,
+                    "report": self.report.to_dict(),
+                },
+            )

@@ -18,6 +18,7 @@ from mapreduce_rust_tpu.coordinator.server import (
     NOT_READY,
     WAIT,
     Coordinator,
+    CoordinatorClient,
 )
 from mapreduce_rust_tpu.core.normalize import reference_word_counts
 from mapreduce_rust_tpu.worker.runtime import Worker
@@ -125,6 +126,70 @@ def test_lease_expiry_recycles_task(tmp_path):
     assert c.map.finished
 
 
+def test_job_report_counts_expiry_and_reexecution(tmp_path):
+    # Unit version of the fault-report contract: a lease expiry followed by
+    # a re-grant shows up as expiries >= 1 and re_executions >= 1 on that
+    # task, with a duration once it completes (ISSUE 1 acceptance).
+    cfg = make_cfg(tmp_path, 1, worker_n=1, lease_timeout_s=0.0)
+    c = Coordinator(cfg)
+    c.get_worker_id()
+    assert c.get_map_task() == 0
+    c.check_lease()  # timeout 0: the lease is already stale
+    assert c.get_map_task() == 0  # re-granted
+    assert c.renew_map_lease(0) is True
+    c.report_map_task_finish(0)
+    assert c.renew_map_lease(0) is False  # stale renewal, counted separately
+    t = c.stats()["tasks"]["map"]["0"]
+    assert t["grants"] == 2
+    assert t["re_executions"] == 1
+    assert t["expiries"] == 1
+    assert t["renewals"] == 1 and t["stale_renewals"] == 1
+    assert t["completed"] and t["duration_s"] >= 0
+
+
+def test_stats_rpc_over_socket(tmp_path):
+    # The 8th RPC rides the same JSON transport as the sentinels and
+    # reflects the live scheduler state, including server-side RPC latency.
+    write_corpus(tmp_path)
+    cfg = make_cfg(tmp_path, len(TEXTS), worker_n=1)
+
+    async def go():
+        coord = Coordinator(cfg)
+        serve = asyncio.create_task(coord.serve())
+        await asyncio.sleep(0.1)
+        client = CoordinatorClient(cfg.host, cfg.port)
+        await client.connect()
+        try:
+            assert await client.call("get_worker_id") == 0
+            tid = await client.call("get_map_task")
+            assert tid == 0
+            rep = await client.call("stats")
+            assert rep["tasks"]["map"][str(tid)]["grants"] == 1
+            assert rep["tasks"]["map"][str(tid)]["completed"] is False
+            assert rep["rpc"]["get_map_task"]["count"] == 1
+            assert rep["rpc"]["get_worker_id"]["max_ms"] >= 0
+        finally:
+            await client.close()
+            serve.cancel()
+            await asyncio.gather(serve, return_exceptions=True)
+
+    asyncio.run(go())
+
+
+def test_worker_report_records_tasks_and_rpc_latency(tmp_path):
+    # The worker keeps its own (client-observed) view: tasks it ran and
+    # the round-trip latency of every RPC it made.
+    write_corpus(tmp_path)
+    cfg = make_cfg(tmp_path, len(TEXTS), worker_n=1)
+    _coord, ws = asyncio.run(_run_cluster(cfg, 1))
+    rep = ws[0].report.to_dict()
+    assert rep["totals"]["map"]["completed"] == len(TEXTS)
+    assert rep["totals"]["reduce"]["completed"] == cfg.reduce_n
+    for method in ("get_map_task", "report_map_task_finish",
+                   "get_reduce_task", "report_reduce_task_finish"):
+        assert rep["rpc"][method]["count"] >= 1
+
+
 # ---- end-to-end over real sockets ----
 
 async def _run_cluster(cfg, n_workers, app=None, engine="host", kill_one=False):
@@ -132,20 +197,25 @@ async def _run_cluster(cfg, n_workers, app=None, engine="host", kill_one=False):
     serve = asyncio.create_task(coord.serve())
     await asyncio.sleep(0.1)
 
-    async def one_worker(i):
-        w = Worker(cfg, app=app, engine=engine)
-        await w.run()
-
-    workers = [asyncio.create_task(one_worker(i)) for i in range(n_workers)]
+    ws = [Worker(cfg, app=app, engine=engine) for _ in range(n_workers)]
+    workers = [asyncio.create_task(w.run()) for w in ws]
     if kill_one:
-        # let it claim a task, then kill it mid-flight (worker death;
-        # SURVEY.md §3-D recovery path)
-        await asyncio.sleep(0.3)
+        # Deterministic kill window: wait until the victim HOLDS a lease
+        # (granted, unfinished task — its own report tells us), then kill
+        # it mid-flight (worker death; SURVEY.md §3-D recovery path). The
+        # lease expiry / re-execution the job report asserts on is then
+        # guaranteed, not a scheduling race.
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + 20
+        while not ws[0].report.in_flight() and loop.time() < deadline:
+            await asyncio.sleep(0.005)
+        assert ws[0].report.in_flight(), "victim never claimed a task"
         workers[0].cancel()
         await asyncio.gather(workers[0], return_exceptions=True)
         workers = workers[1:]
     await asyncio.wait_for(asyncio.gather(*workers), timeout=60)
     await asyncio.wait_for(serve, timeout=30)
+    return coord, ws
 
 
 def test_cluster_word_count_end_to_end(tmp_path):
@@ -163,8 +233,34 @@ def test_cluster_survives_worker_death(tmp_path):
     big = "repeat me many times " * 20000  # slow task: victim dies mid-map
     write_corpus(tmp_path, TEXTS + [big])
     cfg = make_cfg(tmp_path, len(TEXTS) + 1, worker_n=2)
-    asyncio.run(_run_cluster(cfg, 2, kill_one=True))
+    coord, _ws = asyncio.run(_run_cluster(cfg, 2, kill_one=True))
     assert read_outputs(cfg) == oracle(TEXTS + [big])
+    # The fault is VISIBLE in the control-plane job report: the victim's
+    # task (whichever phase it held a lease in when killed) shows >= 1
+    # lease expiry and a re-execution, and the report agrees with the
+    # scheduler that everything completed.
+    rep = coord.stats()
+    total_expiries = sum(t["expiries"] for t in rep["totals"].values())
+    total_reexec = sum(t["re_executions"] for t in rep["totals"].values())
+    assert total_expiries >= 1
+    assert total_reexec >= 1
+    reexecuted = [
+        t for phase in rep["tasks"].values() for t in phase.values()
+        if t["re_executions"] >= 1
+    ]
+    assert reexecuted and all(t["expiries"] >= 1 for t in reexecuted)
+    for phase in rep["tasks"].values():
+        for t in phase.values():
+            assert t["completed"] and t["duration_s"] >= 0
+    # done() dumped the same report to disk for post-hoc probes.
+    import json
+
+    dumped = json.loads(
+        (pathlib.Path(cfg.work_dir) / "job_report.json").read_text()
+    )
+    assert sum(
+        t["expiries"] for t in dumped["report"]["totals"].values()
+    ) >= 1
 
 
 def test_straggler_late_report_after_regrant(tmp_path):

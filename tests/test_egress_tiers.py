@@ -62,9 +62,15 @@ def test_budgeted_outputs_identical_and_runs_on_disk(tmp_path, app_engine):
         dictionary_budget_words=512,   # 3000-word vocab → several runs
     )
     res = run_job(tiered, inputs)
-    # Both tiers genuinely spilled to disk.
-    assert glob.glob(str(tmp_path / f"work-tiered-{app_engine}" / "accrun-*"))
-    assert glob.glob(str(tmp_path / f"work-tiered-{app_engine}" / "dictrun-*"))
+    # Both DISK tiers genuinely engaged: the run-file counts are captured
+    # in the stats at job end, just before the files themselves are
+    # deleted (a shared work_dir must not accumulate accrun-*/dictrun-*
+    # across jobs, ADVICE r5).
+    assert res.stats.accum_spill_runs > 0
+    assert res.stats.dict_spill_runs > 0
+    assert res.stats.spill_events > 0
+    assert not glob.glob(str(tmp_path / f"work-tiered-{app_engine}" / "accrun-*"))
+    assert not glob.glob(str(tmp_path / f"work-tiered-{app_engine}" / "dictrun-*"))
     # Streaming egress: table empty, outputs byte-identical, stats agree.
     assert res.table == {}
     assert read_outputs(tiered) == read_outputs(plain)
@@ -157,6 +163,56 @@ def test_accumulator_runs_fold_exactly(tmp_path):
         tiered.add(keys.copy(), vals.copy())
     assert tiered.has_runs
     assert tiered.table == plain.table
+
+
+def test_run_files_unique_beyond_pid_and_removable(tmp_path):
+    # Two accumulators in ONE process (same pid) must never collide on run
+    # names, and remove_runs must leave the spill dir clean (ADVICE r5).
+    a1 = HostAccumulator("sum", budget_bytes=0, spill_dir=str(tmp_path))
+    a2 = HostAccumulator("sum", budget_bytes=0, spill_dir=str(tmp_path))
+    keys = np.array([[1, 2], [3, 4]])
+    vals = np.array([5, 6])
+    a1.add(keys, vals)
+    a2.add(keys, vals)
+    assert a1._runs and a2._runs
+    assert set(a1._runs).isdisjoint(a2._runs)
+    d1 = Dictionary(budget_words=1, spill_dir=str(tmp_path))
+    d2 = Dictionary(budget_words=1, spill_dir=str(tmp_path))
+    d1.add_words([b"alpha", b"beta"])
+    d2.add_words([b"alpha", b"beta"])
+    assert d1._runs and d2._runs and set(d1._runs).isdisjoint(d2._runs)
+    for tier in (a1, a2, d1, d2):
+        tier.remove_runs()
+        tier.remove_runs()  # idempotent
+    assert not glob.glob(str(tmp_path / "accrun-*"))
+    assert not glob.glob(str(tmp_path / "dictrun-*"))
+
+
+def test_spilled_dictionary_point_probes_raise(tmp_path):
+    # After a budget flush the RAM tier is PARTIAL: __contains__/items()
+    # answering from it alone would silently drop flushed words — they must
+    # raise, and iter_sorted() must keep serving the whole dictionary.
+    d = Dictionary(budget_words=4, spill_dir=str(tmp_path))
+    words = [f"w{i:02d}".encode() for i in range(10)]
+    d.add_words(words)
+    assert d.spilled
+    with pytest.raises(RuntimeError, match="iter_sorted"):
+        (1, 2) in d  # noqa: B015 — the probe itself is the test
+    with pytest.raises(RuntimeError, match="iter_sorted"):
+        d.items()
+    assert sorted(w for _p, _k1, _k2, w in d.iter_sorted()) == sorted(words)
+    # Unspilled dictionaries keep the fast point probes.
+    plain = Dictionary()
+    plain.add_words([b"solo"])
+    assert list(plain.items()) and len(plain) == 1
+
+
+def test_merge_sorted_runs_rejects_empty_haystack():
+    from mapreduce_rust_tpu.core.kv import KVBatch
+    from mapreduce_rust_tpu.ops.groupby import merge_sorted_runs
+
+    with pytest.raises(ValueError, match="zero capacity"):
+        merge_sorted_runs(KVBatch.empty(0), KVBatch.empty(4))
 
 
 def test_dictionary_spill_dedup_and_iter_sorted(tmp_path):
