@@ -73,6 +73,13 @@ from __graft_entry__ import cpu_only_env as _cpu_env  # noqa: E402
 _WS = b" \t\n\r\x0b\x0c"
 
 
+def _env_host_workers() -> "int | None":
+    """--host-workers rides into subprocess legs as BENCH_HOST_WORKERS
+    (None = Config auto: usable cores minus the consumer's)."""
+    v = os.environ.get("BENCH_HOST_WORKERS")
+    return int(v) if v else None
+
+
 def build_corpus(target_mb: int) -> pathlib.Path:
     out = BENCH_DIR / f"corpus-{target_mb}mb.txt"
     if out.exists() and out.stat().st_size >= target_mb << 20:
@@ -136,6 +143,7 @@ def _zipf_cfg(work: str, out: str, reduce_n: int):
 
     return Config(
         map_engine=os.environ.get("BENCH_MAP_ENGINE", "host"),
+        host_map_workers=_env_host_workers(),
         host_window_bytes=16 << 20,
         chunk_bytes=1 << 20,
         merge_capacity=1 << 18,        # << the Zipf vocab: constant eviction
@@ -548,6 +556,7 @@ def device_leg(path: str) -> None:
     on_cpu = platform == "cpu"
     cfg = Config(
         map_engine=os.environ.get("BENCH_MAP_ENGINE", "host"),
+        host_map_workers=_env_host_workers(),
         host_window_bytes=(32 << 20) if on_cpu else (16 << 20),
         chunk_bytes=1 << 20,
         merge_capacity=(1 << 17) if on_cpu else (1 << 18),
@@ -562,11 +571,16 @@ def device_leg(path: str) -> None:
     # Warmup: compile every jitted step on a one-window prefix with the
     # same static shapes as the main run. The step-fn cache makes the main
     # run reuse these compiled closures; the persistent cache makes even
-    # this pass cheap after the first run on a machine image.
+    # this pass cheap after the first run on a machine image. Telemetry is
+    # stripped: a warmup-written run manifest at the same path could pass
+    # the parent's freshness gate and be read as the MEASURED run's stats.
+    import dataclasses
+
     warm = BENCH_DIR / "warmup.txt"
     with open(path, "rb") as f:
         warm.write_bytes(f.read(cfg.host_window_bytes + 4096))
-    run_job(cfg, [str(warm)], write_outputs=False)
+    run_job(dataclasses.replace(cfg, trace_path=None, manifest_path=None),
+            [str(warm)], write_outputs=False)
 
     res = run_job(cfg, [str(path)])
     s = res.stats
@@ -582,6 +596,8 @@ def device_leg(path: str) -> None:
         "bottleneck": s.bottleneck,
         "host_map_s": round(s.host_map_s, 3),
         "host_glue_s": round(s.host_glue_s, 3),
+        "host_workers": s.host_map_workers,
+        "scan_wait_s": round(s.scan_wait_s, 3),
         "map_engine": cfg.map_engine,
         "phases": {k: round(v, 3) for k, v in s.phase_seconds.items()},
         "platform": platform,
@@ -611,10 +627,21 @@ def _run_device_leg(corpus: pathlib.Path, timeout_s: int, env: dict | None,
     """
     import threading
 
+    child_env = dict(os.environ) if env is None else dict(env)
+    run_manifest = None
+    if mode == "--device-leg":
+        # Every measured leg writes its own run manifest (full Config +
+        # JobStats from inside the subprocess): the parent reads STATS from
+        # that structured file, not from stdout-tail scraping — the stdout
+        # JSON stays as the fallback channel for crashed/legacy legs.
+        run_manifest = child_env.setdefault(
+            "BENCH_RUN_MANIFEST", str(BENCH_DIR / "leg-run-manifest.json")
+        )
+    t_start = time.time()
     proc = subprocess.Popen(
         [sys.executable, str(REPO / "bench.py"), mode, str(corpus)],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        env=dict(os.environ) if env is None else env, cwd=str(REPO),
+        env=child_env, cwd=str(REPO),
     )
     ready = threading.Event()
     err_chunks: list[str] = []
@@ -684,9 +711,118 @@ def _run_device_leg(corpus: pathlib.Path, timeout_s: int, env: dict | None,
                 # designed failure signal must not be swallowed by a
                 # successful parse.
                 return None, f"{mode} rc={proc.returncode} (result {line[:200]})"
+            m = _load_leg_manifest(run_manifest, t_start, proc.pid)
+            if m is not None:
+                # Structured channel won: the leg's own run manifest
+                # carries the authoritative JobStats (incl. host_map_split
+                # / ici_split) and phase times.
+                parsed["stats"] = m["stats"]
+                if m.get("phase_seconds") and "info" in parsed:
+                    parsed["info"]["phases"] = {
+                        k: round(v, 3) for k, v in m["phase_seconds"].items()
+                    }
+                parsed["run_manifest"] = run_manifest
+                parsed["stats_source"] = "run_manifest"
             return parsed, None
     tail = ("".join(err_chunks) or out).strip().splitlines()
     return None, f"device leg rc={proc.returncode}: {tail[-1] if tail else 'no output'}"
+
+
+def _load_leg_manifest(path, t_start: float, pid: int):
+    """The leg's run manifest iff it is FRESH (written after this leg
+    started) AND written by THIS leg's process — the manifest embeds the
+    writer's pid (telemetry.platform_info), so a stale file from an
+    earlier leg, a median repeat, or another run can never pass for this
+    leg's stats even inside the mtime slack. None → caller keeps the
+    stdout-parsed fallback (crashed legs never write a manifest)."""
+    try:
+        if path and os.path.getmtime(path) >= t_start - 1.0:
+            with open(path) as f:
+                m = json.load(f)
+            if (
+                m.get("kind") == "run_manifest"
+                and m.get("stats")
+                and m.get("platform", {}).get("pid") == pid
+            ):
+                return m
+    except (OSError, ValueError):
+        pass
+    return None
+
+
+def sweep_host_workers(spec: str) -> None:
+    """`--sweep-host-workers 1,2,4`: one measured device leg per worker
+    count, each leg writing its own run manifest under .bench/sweep/, so
+    scaling curves come from structured files, not scraped logs. Prints
+    ONE JSON line: the curve with per-point GB/s, bottleneck, scan
+    parallelism and the manifest path to diff
+    (`python -m mapreduce_rust_tpu stats run-w1.json run-w4.json`)."""
+    counts = []
+    for tok in spec.split(","):
+        tok = tok.strip()
+        if tok:
+            n = int(tok)
+            if n < 1:
+                raise SystemExit(f"--sweep-host-workers: bad count {n}")
+            counts.append(n)
+    if not counts:
+        raise SystemExit("--sweep-host-workers needs counts, e.g. 1,2,4")
+    corpus = build_corpus(TARGET_MB)
+    sweep_dir = BENCH_DIR / "sweep"
+    sweep_dir.mkdir(parents=True, exist_ok=True)
+    curve = []
+    for n in counts:
+        env = dict(os.environ)
+        env["BENCH_HOST_WORKERS"] = str(n)
+        env["BENCH_RUN_MANIFEST"] = str(sweep_dir / f"run-w{n}.json")
+        if env.get("BENCH_TRACE"):
+            # Per-leg trace files: one shared --trace path would be
+            # rewritten by every leg and end up holding only the last.
+            env["BENCH_TRACE"] = str(sweep_dir / f"trace-w{n}.json")
+        res, err = _run_device_leg(
+            corpus, DEVICE_TIMEOUT_S, env, init_timeout_s=PROBE_TIMEOUT_S
+        )
+        point: dict = {"workers": n, "manifest": env["BENCH_RUN_MANIFEST"]}
+        if res is None:
+            point["error"] = err
+        else:
+            point["gbs"] = round(res["gbs"], 4)
+            s = res.get("stats") or {}
+            point["bottleneck"] = s.get("bottleneck")
+            point["host_map_s"] = s.get("host_map_s")
+            point["scan_wait_s"] = s.get("scan_wait_s")
+            split = s.get("host_map_split") or {}
+            point["scan_parallelism"] = split.get("scan_parallelism")
+        curve.append(point)
+        print(f"sweep w={n}: {json.dumps(point)}", file=sys.stderr)
+    # Anchor strictly to the FIRST requested count: if that leg failed,
+    # every speedup is null — a ratio against some other surviving count
+    # would silently misstate the scaling claim the field names.
+    base = curve[0].get("gbs")
+    result = {
+        "metric": "word_count GB/s vs host-map workers "
+                  f"({TARGET_MB}MB corpus, counts {counts})",
+        "unit": "GB/s",
+        "sweep": curve,
+        "speedup_vs_first": [
+            round(p["gbs"] / base, 2) if p.get("gbs") and base else None
+            for p in curve
+        ],
+    }
+    mp = os.environ.get("BENCH_MANIFEST")
+    if mp:
+        # --manifest in sweep mode: the curve itself is the run's result.
+        try:
+            from mapreduce_rust_tpu.runtime import telemetry
+
+            telemetry.write_manifest(mp, telemetry.build_manifest(
+                {"sweep_counts": counts, "target_mb": TARGET_MB},
+                extra={"kind": "bench_sweep_manifest", "result": result},
+            ))
+            print(f"sweep manifest: {mp}", file=sys.stderr)
+        except Exception as e:  # best-effort, like _write_bench_manifest
+            print(f"sweep manifest write failed: {e!r}", file=sys.stderr)
+    print(json.dumps(result))
 
 
 def main() -> None:
@@ -865,7 +1001,11 @@ def _write_bench_manifest(result: dict, dev, base_gbs) -> None:
                 # not be the median-selected result above. The inner run
                 # manifest's own trace_path pairs correctly with its stats;
                 # point there instead of claiming the pairing here.
-                "last_leg_run_manifest": os.environ.get("BENCH_RUN_MANIFEST") or None,
+                "last_leg_run_manifest": (
+                    (dev or {}).get("run_manifest")
+                    or os.environ.get("BENCH_RUN_MANIFEST")
+                    or None
+                ),
                 "last_leg_trace": os.environ.get("BENCH_TRACE") or None,
             },
         )
@@ -907,8 +1047,28 @@ if __name__ == "__main__":
         os.environ.setdefault(
             "BENCH_RUN_MANIFEST", str(_mp.with_name(_mp.stem + "-run.json"))
         )
+    _workers = _take_flag(_argv, "--host-workers")
+    if _workers:
+        # Validate HERE, like the sweep's count parsing — a bad value must
+        # be a usage error, not an opaque per-leg subprocess traceback.
+        if not _workers.isdigit() or int(_workers) < 1:
+            raise SystemExit(
+                f"--host-workers needs a positive integer, got {_workers!r}"
+            )
+        os.environ["BENCH_HOST_WORKERS"] = _workers
+    _sweep = _take_flag(_argv, "--sweep-host-workers")
     sys.argv = [sys.argv[0]] + _argv
-    if len(sys.argv) > 1 and sys.argv[1] == "--device-leg":
+    if _sweep:
+        try:
+            sweep_host_workers(_sweep)
+        except BaseException as e:  # one JSON line, like the main harness
+            print(json.dumps({
+                "metric": "word_count GB/s vs host-map workers",
+                "unit": "GB/s", "sweep": None,
+                "error": f"sweep harness: {e!r}",
+            }))
+            raise SystemExit(1)
+    elif len(sys.argv) > 1 and sys.argv[1] == "--device-leg":
         device_leg(sys.argv[2])
     elif len(sys.argv) > 1 and sys.argv[1] == "--micro":
         micro_leg()

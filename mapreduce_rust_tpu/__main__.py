@@ -62,6 +62,7 @@ def _cfg(args, map_n: int = 1, worker_n: int = 1) -> Config:
         chunk_bytes=int(args.chunk_mb * (1 << 20)),
         device=args.device,
         map_engine=getattr(args, "map_engine", "device"),
+        host_map_workers=getattr(args, "host_workers", None),
         sharded_stream=getattr(args, "sharded", False),
         checkpoint_every_groups=getattr(args, "checkpoint_every", 0),
         resume=getattr(args, "resume", False),
@@ -213,6 +214,14 @@ def main(argv: list[str] | None = None) -> int:
                    help="device: tokenize/combine fully on-chip; host: fused "
                    "native scan maps on the host, device merges (fastest when "
                    "host->device bandwidth is the bottleneck)")
+    p.add_argument("--host-workers", type=int, default=None, dest="host_workers",
+                   help="host-map engine scan threads (default: usable "
+                   "cores minus one, reserved for the consumer thread). "
+                   "The scan fans out across workers; one "
+                   "consumer folds results in window order, so outputs are "
+                   "bit-identical for any value. The manifest's "
+                   "host_map_split (see the stats subcommand) shows whether "
+                   "scan, glue or device is the ceiling at this setting")
     p.add_argument("--sharded", action="store_true", dest="sharded",
                    help="with --mesh: sequence-parallel ingestion — the byte "
                    "stream is cut at arbitrary offsets across chips and a "

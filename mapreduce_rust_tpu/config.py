@@ -12,6 +12,7 @@ are all fields of one dataclass.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Optional
 
 
@@ -55,6 +56,16 @@ class Config:
                                     # host→device bandwidth is the
                                     # bottleneck (e.g. a tunneled chip).
     host_window_bytes: int = 16 << 20  # map window for the host engine
+    host_map_workers: Optional[int] = None  # scan threads of the host-map
+                                    # engine. None = auto (usable cores
+                                    # minus one reserved for the consumer
+                                    # thread, min 1 — a ≤2-core CI host
+                                    # keeps the single-worker pipeline).
+                                    # The native scan releases the GIL, so
+                                    # N workers scan N windows concurrently
+                                    # while ONE consumer folds results in
+                                    # window order — outputs are
+                                    # bit-identical for any worker count.
     host_update_cap: int = 1 << 16  # fixed per-merge update capacity of the
                                     # host engine; windows with more uniques
                                     # are split across several merges. Fixed
@@ -147,6 +158,26 @@ class Config:
             raise ValueError("chunk_bytes too small for max_word_len halo")
         if self.map_engine not in ("device", "host"):
             raise ValueError(f"unknown map_engine {self.map_engine!r}")
+        if self.host_map_workers is not None and self.host_map_workers < 1:
+            raise ValueError("host_map_workers must be >= 1 (or None for auto)")
+
+    def effective_host_map_workers(self) -> int:
+        """Resolved host-map scan worker count: the explicit knob, or
+        USABLE cores minus one (cpuset/affinity-aware — a containerized
+        2-of-64-cores host must not spawn 64 scan threads). Auto reserves
+        one core for the CONSUMER thread, which is a full-time core of
+        work of its own (dictionary fold + update pack + XLA merge
+        compute on a CPU backend): measured on a 2-core host, 2 scan
+        workers + the consumer oversubscribe and run ~9% SLOWER than the
+        1-worker pipeline, so auto on ≤2 cores keeps exactly the old
+        single-worker overlap. --host-workers overrides for sweeps."""
+        if self.host_map_workers:
+            return max(int(self.host_map_workers), 1)
+        try:
+            n = len(os.sched_getaffinity(0))
+        except (AttributeError, OSError):  # non-Linux
+            n = os.cpu_count() or 1
+        return max(n - 1, 1)
 
     def effective_partial_capacity(self) -> int:
         """The per-chunk distinct-key capacity both stream paths must share

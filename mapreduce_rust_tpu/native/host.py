@@ -145,11 +145,39 @@ def normalize_native(data: bytes) -> bytes | None:
 
 _scratch = threading.local()
 
+# Arena accounting: one scratch arena per scan thread means the host-map
+# engine's memory cost scales with host_map_workers, not with the corpus —
+# the registry makes that price observable (stats.host_arena_bytes / the
+# run manifest) instead of folklore. Entries are keyed by the words buffer
+# and removed by a weakref finalizer when the arena is collected (thread
+# death frees its thread-locals), so the gauge tracks LIVE arenas only.
+_arena_lock = threading.Lock()
+_arena_sizes: dict[int, int] = {}
+
+
+def _arena_release(key: int) -> None:
+    with _arena_lock:
+        _arena_sizes.pop(key, None)
+
+
+def arena_bytes() -> int:
+    """Total bytes of live per-thread scan scratch arenas in this process."""
+    with _arena_lock:
+        return sum(_arena_sizes.values())
+
+
+def arena_count() -> int:
+    """How many threads currently hold a scan scratch arena."""
+    with _arena_lock:
+        return len(_arena_sizes)
+
 
 def _buffers(n: int, max_words: int):
     """Per-thread reusable scratch (allocating ~10 MB of numpy buffers per
     call costs ~40% of the scan; scan results are copied out before the
     next call on the same thread can overwrite them)."""
+    import weakref
+
     bufs = getattr(_scratch, "bufs", None)
     if bufs is None or bufs[0].size < n + 1 or bufs[1].size < max_words:
         bufs = (
@@ -159,6 +187,10 @@ def _buffers(n: int, max_words: int):
             np.empty(max(max_words, 1 << 18), dtype=np.uint32),
             np.empty(max(max_words, 1 << 18), dtype=np.uint32),
         )
+        key = id(bufs[0])
+        with _arena_lock:
+            _arena_sizes[key] = sum(int(b.nbytes) for b in bufs)
+        weakref.finalize(bufs[0], _arena_release, key)
         _scratch.bufs = bufs
     return bufs
 

@@ -43,6 +43,7 @@ last key, which the reference drops (worker.rs:169-184).
 from __future__ import annotations
 
 import collections
+import contextlib
 import dataclasses
 import functools
 import os
@@ -73,7 +74,12 @@ from mapreduce_rust_tpu.runtime.dictionary import (
     remove_run_files,
 )
 from mapreduce_rust_tpu.runtime.metrics import JobStats, log
-from mapreduce_rust_tpu.runtime.trace import start_tracing, stop_tracing, trace_span
+from mapreduce_rust_tpu.runtime.trace import (
+    start_tracing,
+    stop_tracing,
+    trace_counter,
+    trace_span,
+)
 
 _cc_enabled = False
 
@@ -448,6 +454,22 @@ def fold_scan_into_dictionary(dictionary: Dictionary, mask, kind, parts) -> None
 _SENTINEL = object()
 
 
+@contextlib.contextmanager
+def _a2a_span(stats, **span_args):
+    """One mesh.all_to_all block: the trace span PLUS a wall-clock
+    accumulation into stats.all_to_all_s, so the manifest's ICI-vs-compute
+    split exists even for untraced runs (the tracer's per-round summary
+    rides along only when tracing is on). Covers tokenize + bucket scatter
+    + collective + merge dispatch of the round — on an async backend this
+    is dispatch-side time; the blocking tail lands in device_wait_s."""
+    t0 = time.perf_counter()
+    try:
+        with trace_span("mesh.all_to_all", **span_args):
+            yield
+    finally:
+        stats.all_to_all_s += time.perf_counter() - t0
+
+
 class _IngestStream:
     """Shared ingest: a prefetch thread runs read→normalize→chunk ahead of
     the consumer (bounded queue), and a thread pool runs the dictionary
@@ -557,7 +579,11 @@ class _IngestStream:
         else:
             while self.scans:
                 self._fold_done(block=True)
-        self.pool.shutdown(wait=False)
+        # cancel_futures + wait: queued scans cancel, the (bounded) running
+        # ones finish and are reaped — an abandoned scan must not outlive
+        # the stream holding its chunk payload (same contract as the
+        # host-map engine's teardown).
+        self.pool.shutdown(wait=True, cancel_futures=True)
         self._thread.join(timeout=5)
 
 
@@ -762,12 +788,28 @@ def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
     the device-tokenize engine whenever host→device bandwidth, not
     compute, is the ceiling (measured: a tunneled v5e moves ~60 MB/s of
     chunk bytes but >100 MB/s of text through the host scan, whose updates
-    are 10-30× smaller than the text)."""
+    are 10-30× smaller than the text).
+
+    The scan fans out (ISSUE 2 tentpole): ``cfg.host_map_workers`` threads
+    (auto = usable cores, one reserved for this consumer) run the GIL-releasing native scan concurrently —
+    per-thread scratch arenas already isolate them (native/host._buffers)
+    — while THIS thread, the single consumer, folds results into the
+    dictionary and dispatches packed merges strictly IN WINDOW ORDER, so
+    outputs are bit-identical for any worker count. In-flight scans are
+    bounded (a small multiple of the worker count), so host memory stays
+    flat: O(workers) arenas + O(budget) scanned updates + O(depth) device
+    buffers, never O(corpus). The scan workers are PURE functions of their
+    window — all shared state (stats, dictionary, device stream) is
+    touched only here, which is also what makes teardown safe: an orphaned
+    scan can finish into the void without racing the unwound stream."""
+    from mapreduce_rust_tpu.native import host as native_host
     from mapreduce_rust_tpu.native.host import scan_count_raw
 
     enable_compilation_cache(cfg.compilation_cache_dir)
     device = select_device(cfg.device)
     depth = max(cfg.pipeline_depth, 1)
+    workers = cfg.effective_host_map_workers()
+    stats.host_map_workers = workers
     state = jax.device_put(KVBatch.empty(cfg.merge_capacity), device)
     pending: collections.deque = collections.deque()  # (ev_count, evicted)
 
@@ -788,22 +830,26 @@ def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
                     acc.add_batch(evicted)
 
     def scan_window(item):
+        # PURE: reads its window, returns its result + its own duration.
+        # No shared-state writes off the consumer thread — N of these run
+        # concurrently, and an abandoned one (exception teardown) cannot
+        # mutate stats after the stream has unwound.
         doc_id, window = item
         t0 = time.perf_counter()
-        with trace_span("host_map.scan", doc=doc_id):
+        with trace_span("host_map.scan", doc=doc_id, bytes=int(window.size)):
             res = scan_count_raw(window)
-            if res is not None:
-                stats.host_map_s += time.perf_counter() - t0
-                return doc_id, "raw", res
-            out = doc_id, "py", _py_scan_count(window)
-        stats.host_map_s += time.perf_counter() - t0
-        return out
+            out = (
+                (doc_id, "raw", res) if res is not None
+                else (doc_id, "py", _py_scan_count(window))
+            )
+        return (*out, time.perf_counter() - t0)
 
     def consume(result) -> None:
         nonlocal state
+        doc_id, kind, res, scan_s = result
+        stats.host_map_s += scan_s  # aggregate scan seconds across workers
         t_glue = time.perf_counter()
         with trace_span("host_glue"):
-            doc_id, kind, res = result
             stats.chunks += 1
             if kind == "raw":
                 raw, ends, keys, counts = res
@@ -834,24 +880,42 @@ def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
         if len(pending) >= 2 * depth:
             drain(depth)
 
-    # The C scan releases the GIL, so scanning window k+1 on a worker
-    # thread overlaps the Python-side dictionary/pack/dispatch glue of
-    # window k. One worker: scans are the serial backbone and the
-    # per-thread scratch (native/host._buffers) then reuses one arena.
     from concurrent.futures import ThreadPoolExecutor
 
-    pool = ThreadPoolExecutor(max_workers=1)
-    prev = None
+    # In-flight budget: each submitted-but-unconsumed scan pins one memmap
+    # window plus (once done) its compacted result, so 2×workers + 2 keeps
+    # every worker busy while the consumer works through the ordered head —
+    # deep enough to ride out a slow (high-cardinality) window, shallow
+    # enough that memory stays flat.
+    inflight: collections.deque = collections.deque()
+    budget = 2 * workers + 2
+
+    def next_result():
+        fut = inflight.popleft()
+        t0 = time.perf_counter()
+        with trace_span("host_map.stall"):
+            res = fut.result()
+        stats.scan_wait_s += time.perf_counter() - t0
+        trace_counter("host_map.inflight", scans=len(inflight),
+                      merges=len(pending))
+        return res
+
+    pool = ThreadPoolExecutor(max_workers=workers, thread_name_prefix="host-map")
     try:
         for item in _iter_windows(cfg, inputs, stats):
-            fut = pool.submit(scan_window, item)
-            if prev is not None:
-                consume(prev.result())
-            prev = fut
-        if prev is not None:
-            consume(prev.result())
+            inflight.append(pool.submit(scan_window, item))
+            if len(inflight) >= budget:
+                consume(next_result())
+        while inflight:
+            consume(next_result())
+        stats.host_arena_bytes = native_host.arena_bytes()
     finally:
-        pool.shutdown(wait=False)
+        # cancel_futures + wait (the old wait=False shutdown abandoned an
+        # in-flight scan on exception: the orphaned future kept its memmap
+        # window alive past the stream's unwind — ISSUE 2 satellite).
+        # Queued futures cancel; the ≤ workers running scans finish their
+        # pure work and are reaped before the stream frame exits.
+        pool.shutdown(wait=True, cancel_futures=True)
     drain(len(pending))
     acc.add_batch(state)
 
@@ -1018,8 +1082,8 @@ def _stream_multihost(cfg: Config, app: App, inputs, stats, acc, dictionary) -> 
         )
         stats.mesh_rounds += 1
         stats.shuffle_wire_bytes += wire_bytes_per_round(d, bucket_cap)
-        with trace_span("mesh.all_to_all", round=stats.mesh_rounds, tier="fast",
-                        wire_bytes=wire_bytes_per_round(d, bucket_cap)):
+        with _a2a_span(stats, round=stats.mesh_rounds, tier="fast",
+                       wire_bytes=wire_bytes_per_round(d, bucket_cap)):
             local, bad_p, bad_b = fast[0](chunks_g, docs_g)
             state, evicted, ev_counts = fast[1](state, local)
             flags = round_fn(
@@ -1056,12 +1120,17 @@ def _stream_multihost(cfg: Config, app: App, inputs, stats, acc, dictionary) -> 
                 fns, tier_cap = tiers["skew"], u_cap
             stats.mesh_rounds += 1
             stats.shuffle_wire_bytes += wire_bytes_per_round(d, tier_cap)
-            with trace_span("mesh.all_to_all", round=stats.mesh_rounds,
-                            tier="replay",
-                            wire_bytes=wire_bytes_per_round(d, tier_cap)):
+            with _a2a_span(stats, round=stats.mesh_rounds, tier="replay",
+                           wire_bytes=wire_bytes_per_round(d, tier_cap)):
                 local, _p, _b = fns[0](chunks_g, docs_g)
                 state, evicted2, ev2 = fns[1](state, local)
-                fold_local_spill(local_rows(ev2), evicted2)  # rare: own fetch
+            # Fetch + fold outside the a2a block (rare: own fetch) — the
+            # blocking shard read must not inflate all_to_all_s.
+            t0 = time.perf_counter()
+            with trace_span("device.drain", steps=1):
+                ev2_local = local_rows(ev2)
+            stats.device_wait_s += time.perf_counter() - t0
+            fold_local_spill(ev2_local, evicted2)
         fold_local_spill(ev_local, evicted)
         return int(np.asarray(flags_l)[0]) > 0
 
@@ -1238,17 +1307,22 @@ def _stream_sharded(cfg: Config, app: App, inputs, stats, acc, dictionary) -> No
         stats.shuffle_wire_bytes += wire_bytes_per_round(
             d, cfg.max_word_len + shard_bytes + 1
         )
-        with trace_span("mesh.all_to_all", round=stats.mesh_rounds, tier="replay",
-                        wire_bytes=wire_bytes_per_round(
-                            d, cfg.max_word_len + shard_bytes + 1)):
+        with _a2a_span(stats, round=stats.mesh_rounds, tier="replay",
+                       wire_bytes=wire_bytes_per_round(
+                           d, cfg.max_word_len + shard_bytes + 1)):
             kv, _trunc = tokenize(shards)
             local, _p, _b = wide["fns"](kv, docs)
             state, evicted, ev_counts = wide["merge"](state, local)
+        # Readback + spill fold outside the a2a block — see _stream_mesh
+        # replay_group: all_to_all_s must stay interconnect-attributable.
+        t0 = time.perf_counter()
+        with trace_span("device.drain", steps=1):
             ev_n = int(np.asarray(jax.device_get(ev_counts)).sum())
-            if ev_n > 0:
-                stats.spill_events += 1
-                stats.spilled_keys += ev_n
-                acc.add_batch(evicted)
+        stats.device_wait_s += time.perf_counter() - t0
+        if ev_n > 0:
+            stats.spill_events += 1
+            stats.spilled_keys += ev_n
+            acc.add_batch(evicted)
 
     def drain(n: int) -> None:
         if n <= 0:
@@ -1304,9 +1378,8 @@ def _stream_sharded(cfg: Config, app: App, inputs, stats, acc, dictionary) -> No
             off = end
             stats.mesh_rounds += 1
             stats.shuffle_wire_bytes += wire_bytes_per_round(d, bucket_cap)
-            with trace_span("mesh.all_to_all", round=stats.mesh_rounds,
-                            tier="fast",
-                            wire_bytes=wire_bytes_per_round(d, bucket_cap)):
+            with _a2a_span(stats, round=stats.mesh_rounds, tier="fast",
+                           wire_bytes=wire_bytes_per_round(d, bucket_cap)):
                 shards = jax.device_put(
                     shard_stream(group, mesh, pad=shard_bytes), in_shard
                 )
@@ -1389,15 +1462,21 @@ def _stream_mesh(cfg: Config, app: App, inputs, stats, acc, dictionary) -> None:
             fns, tier_cap = tiers["skew"], u_cap
         stats.mesh_rounds += 1
         stats.shuffle_wire_bytes += wire_bytes_per_round(d, tier_cap)
-        with trace_span("mesh.all_to_all", round=stats.mesh_rounds, tier="replay",
-                        wire_bytes=wire_bytes_per_round(d, tier_cap)):
+        with _a2a_span(stats, round=stats.mesh_rounds, tier="replay",
+                       wire_bytes=wire_bytes_per_round(d, tier_cap)):
             local, _, _ = fns[0](chunks_dev, docs_dev)
             state, evicted, ev_counts = fns[1](state, local)
+        # Blocking readback + spill fold OUTSIDE the a2a block: they are
+        # device-wait/host work, and inside they would inflate all_to_all_s
+        # — the ICI numerator — with non-interconnect time.
+        t0 = time.perf_counter()
+        with trace_span("device.drain", steps=1):
             ev_n = int(np.asarray(jax.device_get(ev_counts)).sum())
-            if ev_n > 0:
-                stats.spill_events += 1
-                stats.spilled_keys += ev_n
-                acc.add_batch(evicted)
+        stats.device_wait_s += time.perf_counter() - t0
+        if ev_n > 0:
+            stats.spill_events += 1
+            stats.spilled_keys += ev_n
+            acc.add_batch(evicted)
 
     def drain(n: int) -> None:
         # One batched readback per window — see _stream_single.drain.
@@ -1437,8 +1516,8 @@ def _stream_mesh(cfg: Config, app: App, inputs, stats, acc, dictionary) -> None:
         group_docs.clear()
         stats.mesh_rounds += 1
         stats.shuffle_wire_bytes += wire_bytes_per_round(d, bucket_cap)
-        with trace_span("mesh.all_to_all", round=stats.mesh_rounds, tier="fast",
-                        wire_bytes=wire_bytes_per_round(d, bucket_cap)):
+        with _a2a_span(stats, round=stats.mesh_rounds, tier="fast",
+                       wire_bytes=wire_bytes_per_round(d, bucket_cap)):
             local, p_ovf, b_ovf = fast[0](
                 jax.device_put(chunks_host, in_shard), jax.device_put(docs_host, in_shard)
             )
@@ -1526,8 +1605,6 @@ def run_job(
     tracer = start_tracing() if cfg.trace_path else None
     output_files: list[str] = []
     table: dict = {}
-
-    import contextlib
 
     try:
         prof = (

@@ -53,11 +53,29 @@ class JobStats:
     # blocked on device results. ingest_wait ≫ device_wait → host-bound.
     ingest_wait_s: float = 0.0
     device_wait_s: float = 0.0
-    host_map_s: float = 0.0       # CPU time in the host-map engine's scan
-    host_glue_s: float = 0.0      # host-map engine main-thread work between
-    # scans: dictionary fold + update pack + device_put + merge dispatch —
-    # on a 1-core host this steals directly from the scan thread, so the
-    # split names which of the two to optimize
+    host_map_s: float = 0.0       # CPU seconds in the host-map engine's scan
+    # — AGGREGATE across scan workers (with host_map_workers > 1 this can
+    # legitimately exceed the stream wall time; divide by the worker count
+    # for per-core scan time)
+    host_glue_s: float = 0.0      # host-map engine consumer-thread work
+    # between scans: dictionary fold + update pack + device_put + merge
+    # dispatch — on a 1-core host this steals directly from the scan
+    # thread, so the split names which of the two to optimize
+    host_map_workers: int = 0     # scan threads the host-map engine ran
+                                  # (0 = engine not used this run)
+    scan_wait_s: float = 0.0      # consumer wall time blocked waiting for
+    # the next IN-ORDER scan result: the parallel engine's starvation
+    # signal — large scan_wait means more workers (or a faster scan) would
+    # raise throughput; ~0 means the scans are fully hidden and glue or
+    # device is the ceiling
+    all_to_all_s: float = 0.0     # wall seconds inside mesh.all_to_all
+    # blocks (tokenize + bucket scatter + collective dispatch, replays
+    # included) — the ICI-vs-compute split's numerator: with the per-round
+    # wire bytes (shuffle_wire_bytes) this attributes mesh time to the
+    # interconnect before any multi-chip perf claim
+    host_arena_bytes: int = 0     # native scan scratch resident across ALL
+    # scan threads at job end (native/host.arena_bytes): the memory price
+    # of host_map_workers, flat per thread by construction
 
     @property
     def gb_per_s(self) -> float:
@@ -65,10 +83,16 @@ class JobStats:
 
     @property
     def bottleneck(self) -> str:
+        # With parallel scan workers the aggregate host_map_s no longer
+        # measures wall time; the consumer's scan starvation (scan_wait_s)
+        # is the honest wall-clock attribution for "the scans are the
+        # ceiling" — a fully hidden scan pool must not keep claiming the
+        # bottleneck it used to be.
+        scan = self.host_map_s if self.host_map_workers <= 1 else self.scan_wait_s
         parts = {
             "host-ingest": self.ingest_wait_s,
             "device": self.device_wait_s,
-            "host-map": self.host_map_s,
+            "host-map": scan,
             "host-glue": self.host_glue_s,
         }
         name, val = max(parts.items(), key=lambda kv: kv[1])
@@ -98,5 +122,10 @@ class JobStats:
             f"shuffle[{self.mesh_rounds} rounds, {self.shuffle_wire_bytes / 1e6:.1f} MB wire] "
             f"collisions={self.hash_collisions} unknown={self.unknown_keys} "
             f"waits[ingest={self.ingest_wait_s:.2f}s device={self.device_wait_s:.2f}s "
-            f"glue={self.host_glue_s:.2f}s → {self.bottleneck}] [{phases}]"
+            f"map={self.host_map_s:.2f}s"
+            + (
+                f"/{self.host_map_workers}w stall={self.scan_wait_s:.2f}s"
+                if self.host_map_workers > 1 else ""
+            )
+            + f" glue={self.host_glue_s:.2f}s → {self.bottleneck}] [{phases}]"
         )

@@ -215,10 +215,49 @@ def platform_info() -> dict:
 def stats_to_dict(stats) -> dict:
     """Every JobStats field (the full dataclass — including the
     ingest/device/host-map/host-glue wait split and shuffle_wire_bytes)
-    plus the derived properties."""
+    plus the derived properties and two structured attributions:
+
+    - ``host_map_split`` (host-map engine runs): scan vs glue vs device,
+      with the worker count, the consumer's scan-stall time and the scan
+      arenas' resident bytes — what the next BENCH round reads to see
+      where the ceiling moved after the fan-out.
+    - ``ici_split`` (mesh runs): all_to_all block seconds vs the rest of
+      the stream phase, with rounds and wire bytes — interconnect vs
+      compute, before any multi-chip perf claim.
+    """
     d = dataclasses.asdict(stats)
     d["gb_per_s"] = stats.gb_per_s
     d["bottleneck"] = stats.bottleneck
+    stream_s = stats.phase_seconds.get("stream", 0.0)
+    if stats.host_map_workers > 0:
+        d["host_map_split"] = {
+            "workers": stats.host_map_workers,
+            "scan_s": round(stats.host_map_s, 6),          # aggregate, all workers
+            "scan_stall_s": round(stats.scan_wait_s, 6),   # consumer starved
+            "glue_s": round(stats.host_glue_s, 6),
+            "device_wait_s": round(stats.device_wait_s, 6),
+            "arena_bytes": stats.host_arena_bytes,
+            # scan seconds actually overlapped per worker per stream second;
+            # ~1.0 at W=1, → W when the fan-out scales perfectly
+            "scan_parallelism": (
+                round(stats.host_map_s / stream_s, 3) if stream_s else None
+            ),
+        }
+    if stats.mesh_rounds > 0:
+        d["ici_split"] = {
+            "rounds": stats.mesh_rounds,
+            "all_to_all_s": round(stats.all_to_all_s, 6),
+            "device_wait_s": round(stats.device_wait_s, 6),
+            "stream_s": round(stream_s, 6),
+            "stream_other_s": round(
+                max(stream_s - stats.all_to_all_s - stats.device_wait_s, 0.0), 6
+            ),
+            "wire_bytes": stats.shuffle_wire_bytes,
+            "wire_mb_per_s": (
+                round(stats.shuffle_wire_bytes / stats.all_to_all_s / 1e6, 3)
+                if stats.all_to_all_s else None
+            ),
+        }
     return d
 
 
@@ -280,6 +319,19 @@ def flush_run_artifacts(cfg, tracer=None, tag: str | None = None,
     best-effort: nothing here may raise, or telemetry would mask the run's
     real outcome. Returns the trace file path (or None)."""
     from mapreduce_rust_tpu.runtime.trace import per_process_path
+
+    if tracer is not None:
+        # Per-round mesh.all_to_all span durations, aggregated (count /
+        # total / mean / max): the traced complement of stats.ici_split —
+        # wall attribution per collective round, not just the stream total.
+        try:
+            rounds = tracer.summarize("mesh.all_to_all")
+            if rounds:
+                extra = dict(manifest_fields.get("extra") or {})
+                extra["mesh_round_spans"] = rounds
+                manifest_fields["extra"] = extra
+        except Exception:
+            pass  # telemetry stays best-effort
 
     trace_file = None
     if tracer is not None and cfg.trace_path:
@@ -351,6 +403,24 @@ def format_manifest(m: dict) -> str:
             f"  waits: ingest={s['ingest_wait_s']:.3f}s device={s['device_wait_s']:.3f}s "
             f"host_map={s['host_map_s']:.3f}s host_glue={s['host_glue_s']:.3f}s"
         )
+        hm = s.get("host_map_split")
+        if hm:
+            lines.append(
+                f"  host-map split: {hm['workers']} workers, "
+                f"scan={hm['scan_s']:.3f}s (x{hm['scan_parallelism'] or 0:.2f} "
+                f"parallel), stall={hm['scan_stall_s']:.3f}s "
+                f"glue={hm['glue_s']:.3f}s device={hm['device_wait_s']:.3f}s "
+                f"arenas={hm['arena_bytes'] / 1e6:.0f} MB"
+            )
+        ici = s.get("ici_split")
+        if ici:
+            lines.append(
+                f"  ICI split: all_to_all={ici['all_to_all_s']:.3f}s "
+                f"drain={ici['device_wait_s']:.3f}s "
+                f"other={ici['stream_other_s']:.3f}s of {ici['stream_s']:.3f}s "
+                f"stream ({ici['rounds']} rounds, "
+                f"{ici['wire_bytes'] / 1e6:.1f} MB wire)"
+            )
     for name, secs in (m.get("phase_seconds") or {}).items():
         lines.append(f"  phase {name:<10} {secs:8.3f}s")
     if m.get("trace_path"):

@@ -38,6 +38,8 @@ _ANN = _UNSET
 
 _tracer: "Tracer | None" = None
 
+_COUNTER = object()  # t1 slot marker: the event is a "C" counter sample
+
 
 def _annotation_cls():
     """jax.profiler.TraceAnnotation iff jax is ALREADY imported, else None.
@@ -84,8 +86,37 @@ class Tracer:
         t = time.perf_counter()
         self._events.append((name, t, None, threading.get_ident(), args or None))
 
+    def counter(self, name: str, **values) -> None:
+        """A Chrome "C" counter sample (numeric values only) — Perfetto
+        renders these as a gauge track (e.g. the host-map engine's
+        in-flight scan depth over time). Same one-append hot path as a
+        span."""
+        t = time.perf_counter()
+        self._events.append((name, t, _COUNTER, threading.get_ident(), values))
+
     def __len__(self) -> int:
         return len(self._events)
+
+    def summarize(self, name: str) -> "dict | None":
+        """Aggregate of the named complete spans — {count, total_s,
+        mean_ms, max_ms} — or None when the buffer holds none. Used at
+        manifest-flush time (one pass over the buffer, off the hot path)
+        to surface e.g. per-round mesh.all_to_all durations without
+        shipping every event into the manifest."""
+        durs = [
+            t1 - t0
+            for n, t0, t1, _tid, _args in self._events
+            if n == name and t1 is not None and t1 is not _COUNTER
+        ]
+        if not durs:
+            return None
+        total = sum(durs)
+        return {
+            "count": len(durs),
+            "total_s": round(total, 6),
+            "mean_ms": round(total / len(durs) * 1e3, 3),
+            "max_ms": round(max(durs) * 1e3, 3),
+        }
 
     def events(self) -> list[dict]:
         """The buffer as Chrome trace-event dicts (µs since the epoch)."""
@@ -93,12 +124,14 @@ class Tracer:
         for name, t0, t1, tid, args in self._events:
             ev = {
                 "name": name,
-                "ph": "X" if t1 is not None else "i",
+                "ph": "C" if t1 is _COUNTER else ("X" if t1 is not None else "i"),
                 "ts": (t0 - self._epoch) * 1e6,
                 "pid": self._pid,
                 "tid": tid,
             }
-            if t1 is not None:
+            if t1 is _COUNTER:
+                pass  # counter samples carry only their args values
+            elif t1 is not None:
                 ev["dur"] = (t1 - t0) * 1e6
             else:
                 ev["s"] = "t"  # instant event scope: thread
@@ -165,6 +198,15 @@ def trace_span(name: str, **args):
         if ann is not None:
             ann.__exit__(None, None, None)
         tr.add_span(name, t0, t1, args or None)
+
+
+def trace_counter(name: str, **values) -> None:
+    """Record a counter sample on the active tracer — no-op (one global
+    read) when tracing is off. Values must be numeric (Chrome "C" event
+    semantics)."""
+    tr = _tracer
+    if tr is not None:
+        tr.counter(name, **values)
 
 
 def per_process_path(path: str, tag: str) -> str:

@@ -1,0 +1,204 @@
+"""Multi-core host-map engine (ISSUE 2 tentpole): the scan fan-out must be
+invisible in the results — final counts, dictionary contents, spill totals
+and the output FILES bit-identical for any worker count, including
+forced-cut windows and filtering apps — while the manifest grows the
+scan/glue/device and ICI-vs-compute splits, and tracing the parallel path
+stays per-window, never per-record."""
+
+import json
+import pathlib
+
+import pytest
+
+from mapreduce_rust_tpu.apps import get_app
+from mapreduce_rust_tpu.config import Config
+from mapreduce_rust_tpu.core.normalize import reference_word_counts
+from mapreduce_rust_tpu.runtime import telemetry
+from mapreduce_rust_tpu.runtime.driver import run_job
+from mapreduce_rust_tpu.runtime.trace import validate_events
+
+WORKER_COUNTS = [1, 2, 4]
+
+# ~40 windows at 4 KB, multi-doc, with a whitespace-free run longer than a
+# window so at least one window is FORCE-cut mid-token (the determinism
+# claim must hold through that path too: fragments, not whole tokens, but
+# the SAME fragments for every worker count).
+TEXTS = [
+    ("the quick brown fox jumps over the lazy dog " * 600
+     + "x" * 6000 + " "
+     + "pack my box with five dozen liquor jugs " * 500),
+    # High-cardinality tail: >> merge_capacity distinct keys, so the
+    # device state constantly evicts to the host accumulator (the spill
+    # totals the determinism claim must also cover).
+    ("zebra quagga okapi " * 2000
+     + " ".join(f"w{i:05d}" for i in range(3000))),
+]
+
+
+def write_inputs(tmp_path, texts):
+    paths = []
+    for i, t in enumerate(texts):
+        p = tmp_path / f"doc-{i}.txt"
+        p.write_bytes(t if isinstance(t, bytes) else t.encode())
+        paths.append(str(p))
+    return paths
+
+
+def cfg_for(tmp_path, tag: str, workers: int, **kw) -> Config:
+    defaults = dict(
+        map_engine="host",
+        host_map_workers=workers,
+        host_window_bytes=4096,
+        host_update_cap=256,        # force multi-merge splits per window
+        merge_capacity=512,         # force device→host spills
+        reduce_n=4,
+        output_dir=str(tmp_path / f"out-{tag}-w{workers}"),
+        work_dir=str(tmp_path / f"work-{tag}-w{workers}"),
+        device="cpu",
+    )
+    defaults.update(kw)
+    return Config(**defaults)
+
+
+def output_bytes(res) -> list[bytes]:
+    return [pathlib.Path(p).read_bytes() for p in res.output_files]
+
+
+def test_worker_counts_bit_identical_with_forced_cut_and_spills(tmp_path):
+    paths = write_inputs(tmp_path, TEXTS)
+    runs = {}
+    for w in WORKER_COUNTS:
+        res = run_job(cfg_for(tmp_path, "wc", w), paths)
+        assert res.stats.host_map_workers == w
+        assert res.stats.forced_cuts > 0      # the forced-cut window ran
+        assert res.stats.spill_events > 0     # the spill path ran
+        runs[w] = res
+    first = runs[WORKER_COUNTS[0]]
+    for w in WORKER_COUNTS[1:]:
+        res = runs[w]
+        # Results, dictionary size, spill totals and the files themselves.
+        assert res.table == first.table
+        assert res.stats.dictionary_words == first.stats.dictionary_words
+        assert res.stats.spilled_keys == first.stats.spilled_keys
+        assert res.stats.spill_events == first.stats.spill_events
+        assert res.stats.chunks == first.stats.chunks
+        assert output_bytes(res) == output_bytes(first)
+
+
+def test_worker_counts_match_oracle_without_forced_cuts(tmp_path):
+    # No giant token → window cuts stay whitespace-aligned → the oracle
+    # (reference semantics over the whole text) applies exactly.
+    texts = ["alpha beta gamma delta epsilon " * 1500]
+    paths = write_inputs(tmp_path, texts)
+    import collections
+
+    oracle = collections.Counter(reference_word_counts(texts[0].encode()))
+    oracle = {w.encode(): c for w, c in oracle.items()}
+    for w in WORKER_COUNTS:
+        res = run_job(cfg_for(tmp_path, "oracle", w, merge_capacity=1 << 14),
+                      paths, write_outputs=False)
+        assert res.table == oracle
+        assert res.stats.unknown_keys == 0
+
+
+def test_grep_filtering_identical_across_workers(tmp_path):
+    paths = write_inputs(tmp_path, TEXTS)
+    runs = {}
+    for w in WORKER_COUNTS:
+        app = get_app("grep", query=("fox", "zebra", "missingword"))
+        res = run_job(cfg_for(tmp_path, "grep", w, merge_capacity=1 << 14),
+                      paths, app=app)
+        runs[w] = res
+    first = runs[WORKER_COUNTS[0]]
+    assert first.table == {b"fox": [0], b"zebra": [1]}
+    for w in WORKER_COUNTS[1:]:
+        assert runs[w].table == first.table
+        assert output_bytes(runs[w]) == output_bytes(first)
+        # The filter keeps the dictionary query-sized on every worker count.
+        assert runs[w].stats.dictionary_words == first.stats.dictionary_words
+
+
+def test_manifest_host_map_split_and_trace(tmp_path):
+    paths = write_inputs(tmp_path, TEXTS)
+    cfg = cfg_for(
+        tmp_path, "manifest", 2,
+        trace_path=str(tmp_path / "trace.json"),
+        manifest_path=str(tmp_path / "manifest.json"),
+    )
+    res = run_job(cfg, paths, write_outputs=False)
+    m = telemetry.load_manifest(cfg.manifest_path)
+    split = m["stats"]["host_map_split"]
+    assert split["workers"] == 2
+    assert split["scan_s"] > 0 and split["glue_s"] >= 0
+    assert split["scan_stall_s"] >= 0 and split["device_wait_s"] >= 0
+    assert split["arena_bytes"] > 0          # N live scan arenas accounted
+    assert m["stats"]["scan_wait_s"] == pytest.approx(
+        split["scan_stall_s"], abs=1e-5
+    )
+
+    events = json.load(open(cfg.trace_path))["traceEvents"]
+    validate_events(events)
+    scans = [e for e in events if e["name"] == "host_map.scan"]
+    assert len(scans) == res.stats.chunks     # one span per window
+    assert {e["tid"] for e in scans}          # worker threads carried spans
+    # The queue-depth gauge rides as Chrome counter samples.
+    gauges = [e for e in events if e["name"] == "host_map.inflight"]
+    assert gauges and all(e["ph"] == "C" for e in gauges)
+    assert all("scans" in e["args"] and "merges" in e["args"] for e in gauges)
+
+
+def test_parallel_trace_overhead_stays_per_window(tmp_path):
+    # The observability doctrine: spans per window/merge/drain, NEVER per
+    # record. A structural bound (events vs windows) is deterministic where
+    # a wall-clock ratio would flake on a loaded CI host.
+    paths = write_inputs(tmp_path, TEXTS)
+    cfg = cfg_for(
+        tmp_path, "overhead", 4,
+        trace_path=str(tmp_path / "trace-ovh.json"),
+    )
+    res = run_job(cfg, paths, write_outputs=False)
+    events = json.load(open(cfg.trace_path))["traceEvents"]
+    n_records = sum(len(t.split()) for t in TEXTS)
+    # Each window contributes O(1) spans (scan, stall, glue, gauge) plus
+    # its merge splits; far below one event per record.
+    assert len(events) < 20 * res.stats.chunks + 200
+    assert len(events) < n_records / 10
+
+
+def test_mesh_manifest_ici_split(tmp_path):
+    paths = write_inputs(tmp_path, [TEXTS[1]])
+    cfg = Config(
+        chunk_bytes=4096,
+        merge_capacity=1 << 12,
+        mesh_shape=4,
+        reduce_n=4,
+        device="cpu",
+        output_dir=str(tmp_path / "out-mesh"),
+        work_dir=str(tmp_path / "work-mesh"),
+        trace_path=str(tmp_path / "trace-mesh.json"),
+        manifest_path=str(tmp_path / "manifest-mesh.json"),
+    )
+    res = run_job(cfg, paths, write_outputs=False)
+    assert res.stats.mesh_rounds > 0
+    assert res.stats.all_to_all_s > 0
+    m = telemetry.load_manifest(cfg.manifest_path)
+    ici = m["stats"]["ici_split"]
+    assert ici["rounds"] == res.stats.mesh_rounds
+    assert ici["all_to_all_s"] > 0
+    assert ici["wire_bytes"] == res.stats.shuffle_wire_bytes
+    assert ici["stream_s"] >= ici["all_to_all_s"]
+    # The traced complement: per-round span aggregate, one per round.
+    spans = m["mesh_round_spans"]
+    assert spans["count"] == res.stats.mesh_rounds
+    # Each span lies inside its _a2a_span timing window, so the aggregate
+    # can only undershoot the stats total (by per-round bookkeeping).
+    assert 0 < spans["total_s"] <= ici["all_to_all_s"] + 0.05
+
+
+def test_host_map_workers_config_validation():
+    assert Config(host_map_workers=3).effective_host_map_workers() == 3
+    assert Config().effective_host_map_workers() >= 1
+    with pytest.raises(ValueError):
+        Config(host_map_workers=0)
+    with pytest.raises(ValueError):
+        Config(host_map_workers=-2)
