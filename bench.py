@@ -1032,8 +1032,22 @@ def _take_flag(argv: list, flag: str) -> str | None:
     return None
 
 
+def _take_switch(argv: list, flag: str) -> bool:
+    """Pop a valueless `flag` from argv (same contract as _take_flag)."""
+    if flag in argv:
+        argv.remove(flag)
+        return True
+    return False
+
+
 if __name__ == "__main__":
     _argv = sys.argv[1:]
+    if _take_switch(_argv, "--sanitize"):
+        # Thread-ownership sanitizer on every leg: the env var rides into
+        # both inherited and cpu_only_env subprocess environments (the
+        # accel-prefix scrub doesn't touch MR_*), so a bench under
+        # --sanitize measures the sanitized engines end-to-end.
+        os.environ["MR_SANITIZE"] = "1"
     _trace = _take_flag(_argv, "--trace")
     if _trace:
         os.environ["BENCH_TRACE"] = str(pathlib.Path(_trace).resolve())

@@ -23,8 +23,12 @@ import logging
 import os
 import sys
 
-from mapreduce_rust_tpu.apps import REGISTRY, get_app
 from mapreduce_rust_tpu.config import Config
+
+# The app registry import pulls in the jax-importing app modules; keep this
+# module importable without them so pure control-plane/tooling subcommands
+# (lint, stats, clean) start in milliseconds, backend-free.
+_APP_NAMES = ("grep", "inverted_index", "top_k", "word_count")
 
 
 def _add_common(p: argparse.ArgumentParser) -> None:
@@ -32,7 +36,7 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--pattern", default="*.txt")
     p.add_argument("--output", default="mr-out")
     p.add_argument("--work", default="mr-work")
-    p.add_argument("--app", default="word_count", choices=sorted(REGISTRY))
+    p.add_argument("--app", default="word_count", choices=list(_APP_NAMES))
     p.add_argument("--k", type=int, default=20, help="top_k selection size")
     p.add_argument("--query", default="",
                    help="grep: comma-separated words to search for")
@@ -51,10 +55,20 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="write the machine-readable run manifest (config, "
                    "platform, git rev, JobStats, phase times, trace path); "
                    "inspect/diff with the `stats` subcommand")
+    p.add_argument("--sanitize", action="store_true",
+                   help="thread-ownership sanitizer: cross-thread writes to "
+                   "JobStats/the egress dictionary and scan-arena aliasing "
+                   "raise at the fault site (also: MR_SANITIZE=1 env)")
     p.add_argument("-v", "--verbose", action="store_true")
 
 
 def _cfg(args, map_n: int = 1, worker_n: int = 1) -> Config:
+    if getattr(args, "sanitize", False):
+        # Export the env form too: the env-only checkpoints (native arena
+        # ownership in native/host, trace validation in Tracer.write) and
+        # any child process must see the same enablement as Config.sanitize
+        # — bench.py does the same for its legs.
+        os.environ["MR_SANITIZE"] = "1"
     return Config(
         map_n=max(map_n, 1),
         reduce_n=args.reduce_n,
@@ -72,6 +86,7 @@ def _cfg(args, map_n: int = 1, worker_n: int = 1) -> Config:
         profile_dir=args.profile_dir,
         trace_path=getattr(args, "trace", None),
         manifest_path=getattr(args, "manifest", None),
+        sanitize=getattr(args, "sanitize", False),
         host=args.host,
         port=args.port,
         input_dir=args.input,
@@ -82,6 +97,8 @@ def _cfg(args, map_n: int = 1, worker_n: int = 1) -> Config:
 
 
 def _app(args):
+    from mapreduce_rust_tpu.apps import get_app
+
     if args.app == "top_k":
         return get_app(args.app, k=args.k)
     if args.app == "grep":
@@ -182,6 +199,15 @@ def cmd_stats(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    """mrlint: the framework-invariant static analyzer (analysis/). Pure
+    ast + stdlib — no jax import, so it runs in any process in
+    milliseconds; tests/test_lint_clean.py gates tier-1 on exit 0."""
+    from mapreduce_rust_tpu.analysis.lint import run_cli
+
+    return run_cli(args)
+
+
 def cmd_clean(args) -> int:
     """Reference src/clean.sh:7-12: remove intermediates + outputs."""
     removed = 0
@@ -264,6 +290,27 @@ def main(argv: list[str] | None = None) -> int:
     p = sub.add_parser("clean", help="remove intermediates and outputs")
     _add_common(p)
 
+    p = sub.add_parser(
+        "lint",
+        help="mrlint: framework-invariant static analysis of the source tree",
+    )
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to lint (default: the installed package, "
+                   "tests/, bench.py and __graft_entry__.py)")
+    p.add_argument("--format", choices=["text", "json"], default="text",
+                   help="json: one machine-readable document (findings + "
+                   "suppression accounting) for CI diffs")
+    p.add_argument("--baseline", default=None, metavar="PATH",
+                   help="suppression file (.mrlint.json is auto-loaded from "
+                   "the CWD when present): {\"suppressions\": [{\"rule\", "
+                   "\"path\", \"reason\"}]} — every entry needs a reason")
+    p.add_argument("--check-trace", default=None, metavar="TRACE",
+                   dest="check_trace",
+                   help="validate a written Chrome trace file instead of "
+                   "linting source (span nesting, B/E balance, counter "
+                   "value types)")
+    p.add_argument("-v", "--verbose", action="store_true")
+
     p = sub.add_parser("stats", help="pretty-print a run manifest, or diff two")
     p.add_argument("manifest", help="manifest.json of a run")
     p.add_argument("other", nargs="?", default=None,
@@ -283,6 +330,7 @@ def main(argv: list[str] | None = None) -> int:
         "merge": cmd_merge,
         "clean": cmd_clean,
         "stats": cmd_stats,
+        "lint": cmd_lint,
     }[args.cmd](args)
 
 

@@ -1,0 +1,26 @@
+"""mrlint — framework-invariant static analysis + runtime sanitizer.
+
+Every rule in this package is grounded in a bug this repo actually
+shipped and later fixed by hand (see rules.py per-rule docstrings for the
+incident each one encodes). The package has two halves:
+
+- ``lint``/``rules``: an AST-based analyzer run as
+  ``python -m mapreduce_rust_tpu lint`` — the static side, wired into
+  tier-1 via tests/test_lint_clean.py so the invariants are machine-checked
+  on every commit instead of rediscovered per PR.
+- ``sanitize``: the opt-in dynamic companion (``Config.sanitize`` /
+  ``MR_SANITIZE=1``) — thread-ownership asserts on JobStats, the egress
+  Dictionary and the native scan arenas, catching at runtime the ownership
+  violations the static rules can't prove structurally.
+
+No jax import anywhere in this package: the linter must run in a
+backend-free process (CI, pre-commit) in milliseconds.
+"""
+
+from mapreduce_rust_tpu.analysis.lint import (  # noqa: F401
+    Finding,
+    LintReport,
+    lint_paths,
+    load_baseline,
+)
+from mapreduce_rust_tpu.analysis.rules import ALL_RULES  # noqa: F401

@@ -1,0 +1,378 @@
+"""mrlint framework: file discovery, rule driver, suppressions, output.
+
+Design constraints:
+
+- **Backend-free and fast.** Pure ``ast`` + stdlib; linting the whole repo
+  is tens of milliseconds, so it can gate tier-1 (tests/test_lint_clean.py)
+  without moving the suite's runtime.
+- **Zero findings is the contract.** The shipped tree lints clean with an
+  EMPTY baseline; anything that must stay gets an inline
+  ``# mrlint: ignore[rule] -- reason`` (the reason is mandatory — a bare
+  ignore is itself a finding) or a ``.mrlint.json`` baseline entry with a
+  ``reason`` field. Suppression without a recorded why is how the PR-2
+  class of bug got re-shipped; the format forbids it.
+- **Machine-readable.** ``--format json`` emits one stable document
+  (findings + suppression accounting) so CI can diff runs; the baseline
+  file is itself JSON with the same vocabulary.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import fnmatch
+import json
+import os
+import re
+import sys
+import tokenize
+from typing import Iterable, Iterator, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str
+    path: str           # repo-relative posix path
+    line: int           # 1-based
+    col: int            # 0-based (ast convention)
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col + 1}: [{self.rule}] {self.message}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class LintReport:
+    findings: list[Finding]
+    suppressed: int = 0              # inline-ignored findings
+    baselined: int = 0               # baseline-suppressed findings
+    files_checked: int = 0
+    unused_baseline: list[dict] = dataclasses.field(default_factory=list)
+    parse_errors: list[Finding] = dataclasses.field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.parse_errors
+
+    def to_dict(self) -> dict:
+        from mapreduce_rust_tpu.analysis.rules import ALL_RULES
+
+        return {
+            "tool": "mrlint",
+            "schema": 1,
+            "ok": self.ok,
+            "files_checked": self.files_checked,
+            "rules": sorted(r.name for r in ALL_RULES),
+            "findings": [f.to_dict() for f in self.findings + self.parse_errors],
+            "suppressed_inline": self.suppressed,
+            "suppressed_baseline": self.baselined,
+            "unused_baseline_entries": self.unused_baseline,
+        }
+
+
+# ---------------------------------------------------------------------------
+# AST helpers shared by the rules
+# ---------------------------------------------------------------------------
+
+def attach_parents(tree: ast.AST) -> None:
+    """Annotate every node with ``.mr_parent`` so rules can walk upward
+    (enclosing with/try/loop/function) without re-deriving the spine."""
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child.mr_parent = node  # type: ignore[attr-defined]
+
+
+def ancestors(node: ast.AST) -> Iterator[ast.AST]:
+    cur = getattr(node, "mr_parent", None)
+    while cur is not None:
+        yield cur
+        cur = getattr(cur, "mr_parent", None)
+
+
+def qualname(node: ast.AST) -> str:
+    """Dotted source name of a Name/Attribute chain ('' for anything else):
+    ``jax.jit`` → "jax.jit", ``self.pool.submit`` → "self.pool.submit"."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def enclosing_function(node: ast.AST) -> "ast.FunctionDef | ast.AsyncFunctionDef | None":
+    for anc in ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return anc
+    return None
+
+
+def enclosing_class(node: ast.AST) -> "ast.ClassDef | None":
+    for anc in ancestors(node):
+        if isinstance(anc, ast.ClassDef):
+            return anc
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Inline suppressions
+# ---------------------------------------------------------------------------
+
+# `# mrlint: ignore[rule-a, rule-b] -- reason` (the `--` is optional but the
+# reason text is not: an unreasoned ignore does not suppress and is reported).
+_IGNORE_RE = re.compile(
+    r"#\s*mrlint:\s*ignore\[([A-Za-z0-9_\-, ]+)\]\s*(?:--\s*)?(.*)"
+)
+
+
+def _inline_ignores(src: str, path: str) -> tuple[dict[int, set[str]], list[Finding]]:
+    """line → suppressed rule names, plus findings for unreasoned ignores.
+
+    Comments are read with ``tokenize`` (not a line regex) so string
+    literals containing the marker don't suppress anything.
+    """
+    ignores: dict[int, set[str]] = {}
+    bad: list[Finding] = []
+    try:
+        tokens = tokenize.generate_tokens(iter(src.splitlines(True)).__next__)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _IGNORE_RE.search(tok.string)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            reason = m.group(2).strip()
+            if not reason:
+                bad.append(Finding(
+                    "bad-suppression", path, tok.start[0], tok.start[1],
+                    "inline ignore without a reason — write "
+                    "'# mrlint: ignore[rule] -- why it is safe'",
+                ))
+                continue
+            ignores.setdefault(tok.start[0], set()).update(rules)
+    except tokenize.TokenError:
+        pass  # the parse error is reported by the main loop
+    return ignores, bad
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+
+def load_baseline(path: str) -> list[dict]:
+    """``.mrlint.json``: {"suppressions": [{"rule", "path", "reason"}]}.
+    Every entry needs all three fields — a reasonless or pathless entry is
+    a config error, raised loudly (CI must not silently suppress)."""
+    with open(path) as f:
+        data = json.load(f)
+    entries = data.get("suppressions", [])
+    if not isinstance(entries, list):
+        raise ValueError(f"{path}: 'suppressions' must be a list")
+    for i, e in enumerate(entries):
+        if not isinstance(e, dict) or not all(
+            isinstance(e.get(k), str) and e.get(k) for k in ("rule", "path", "reason")
+        ):
+            raise ValueError(
+                f"{path}: suppression #{i} needs non-empty string fields "
+                f"'rule', 'path' and 'reason' (got {e!r})"
+            )
+    return entries
+
+
+def _baseline_match(entry: dict, finding: Finding) -> bool:
+    return (
+        (entry["rule"] == "*" or entry["rule"] == finding.rule)
+        and fnmatch.fnmatch(finding.path, entry["path"])
+    )
+
+
+# ---------------------------------------------------------------------------
+# Driver
+# ---------------------------------------------------------------------------
+
+_SKIP_DIRS = {"__pycache__", ".git", ".jax_cache", ".bench", "node_modules"}
+
+
+def discover_files(paths: Sequence[str]) -> list[str]:
+    out: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if d not in _SKIP_DIRS)
+                out.extend(
+                    os.path.join(root, f) for f in sorted(files) if f.endswith(".py")
+                )
+        elif p.endswith(".py") and os.path.exists(p):
+            out.append(p)
+    # De-dup while keeping order (a file reachable via two roots).
+    seen: set[str] = set()
+    uniq = []
+    for p in out:
+        rp = os.path.realpath(p)
+        if rp not in seen:
+            seen.add(rp)
+            uniq.append(p)
+    return uniq
+
+
+def default_roots() -> list[str]:
+    """What ``lint`` checks with no path arguments: the package itself plus
+    the repo-root siblings that ship with it (tests, bench, graft entry) —
+    derived from the package location, not the CWD, so the gate test checks
+    the same tree no matter where pytest runs."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    repo = os.path.dirname(pkg)
+    roots = [pkg]
+    for sib in ("tests", "bench.py", "__graft_entry__.py"):
+        p = os.path.join(repo, sib)
+        if os.path.exists(p):
+            roots.append(p)
+    return roots
+
+
+def _rel(path: str) -> str:
+    """Repo-relative posix path (stable across machines for baselines)."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    repo = os.path.dirname(pkg)
+    try:
+        rel = os.path.relpath(os.path.abspath(path), repo)
+    except ValueError:  # different drive (windows) — keep as-is
+        rel = path
+    if rel.startswith(".."):
+        rel = path
+    return rel.replace(os.sep, "/")
+
+
+def lint_file(path: str, rules: Iterable | None = None) -> tuple[list[Finding], list[Finding], int]:
+    """(findings, parse/suppression errors, inline-suppressed count)."""
+    from mapreduce_rust_tpu.analysis.rules import ALL_RULES
+
+    rules = list(rules) if rules is not None else ALL_RULES
+    rel = _rel(path)
+    try:
+        with open(path, "rb") as f:
+            src = f.read().decode("utf-8", errors="replace")
+        tree = ast.parse(src, filename=path)
+    except (OSError, SyntaxError) as e:
+        return [], [Finding("parse-error", rel, getattr(e, "lineno", 1) or 1, 0,
+                            f"cannot lint: {e}")], 0
+    attach_parents(tree)
+    ignores, bad_ignores = _inline_ignores(src, rel)
+    findings: list[Finding] = []
+    for rule in rules:
+        findings.extend(rule.check(tree, src, rel))
+    kept: list[Finding] = []
+    suppressed = 0
+    for f in sorted(findings, key=lambda f: (f.line, f.col, f.rule)):
+        # An ignore suppresses on its own line or the line directly below
+        # (comment-above style) — never file-wide.
+        cov = ignores.get(f.line, set()) | ignores.get(f.line - 1, set())
+        if f.rule in cov or "*" in cov:
+            suppressed += 1
+        else:
+            kept.append(f)
+    return kept, bad_ignores, suppressed
+
+
+def lint_paths(paths: Sequence[str] | None = None,
+               baseline: list[dict] | None = None) -> LintReport:
+    files = discover_files(list(paths) if paths else default_roots())
+    report = LintReport(findings=[], files_checked=len(files))
+    used = [0] * len(baseline or [])
+    for path in files:
+        findings, errors, suppressed = lint_file(path)
+        report.suppressed += suppressed
+        report.parse_errors.extend(errors)
+        for f in findings:
+            hit = None
+            for i, entry in enumerate(baseline or []):
+                if _baseline_match(entry, f):
+                    hit = i
+                    break
+            if hit is None:
+                report.findings.append(f)
+            else:
+                used[hit] += 1
+                report.baselined += 1
+    report.unused_baseline = [
+        e for i, e in enumerate(baseline or []) if not used[i]
+    ]
+    return report
+
+
+# ---------------------------------------------------------------------------
+# CLI (dispatched from mapreduce_rust_tpu.__main__)
+# ---------------------------------------------------------------------------
+
+def run_cli(args) -> int:
+    """The ``lint`` subcommand body. Exit 0 = clean (suppressions counted,
+    not failing); 1 = findings; 2 = config error (bad baseline)."""
+    if getattr(args, "check_trace", None):
+        return _check_trace(args.check_trace)
+
+    baseline_path = getattr(args, "baseline", None)
+    if baseline_path is None and os.path.exists(".mrlint.json"):
+        baseline_path = ".mrlint.json"
+    try:
+        baseline = load_baseline(baseline_path) if baseline_path else None
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"mrlint: bad baseline: {e}", file=sys.stderr)
+        return 2
+
+    paths = getattr(args, "paths", None) or None
+    if paths and not discover_files(list(paths)):
+        # Explicit targets resolving to nothing is a config error, not a
+        # clean tree — a mistyped CI path must not pass as "0 findings".
+        print(
+            f"mrlint: no .py files under {list(paths)!r} — nothing checked",
+            file=sys.stderr,
+        )
+        return 2
+
+    report = lint_paths(paths, baseline)
+
+    if getattr(args, "format", "text") == "json":
+        print(json.dumps(report.to_dict(), indent=2, sort_keys=True))
+    else:
+        for f in report.findings + report.parse_errors:
+            print(f.format())
+        for e in report.unused_baseline:
+            print(
+                f"mrlint: warning: unused baseline entry "
+                f"{e['rule']} @ {e['path']} ({e['reason']})",
+                file=sys.stderr,
+            )
+        n = len(report.findings) + len(report.parse_errors)
+        print(
+            f"mrlint: {report.files_checked} files, {n} finding(s), "
+            f"{report.suppressed} inline-suppressed, "
+            f"{report.baselined} baselined"
+        )
+    return 0 if report.ok else 1
+
+
+def _check_trace(path: str) -> int:
+    """--check-trace: run the trace validator on a written trace file, so
+    trace artifacts are checkable the same way source is (ISSUE 3
+    satellite — validate_events rejects unbalanced B/E pairs and
+    non-numeric counter samples)."""
+    from mapreduce_rust_tpu.runtime.trace import validate_events
+
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        events = doc["traceEvents"] if isinstance(doc, dict) else doc
+        validate_events(events)
+    except (OSError, KeyError, ValueError, json.JSONDecodeError) as e:
+        print(f"mrlint: {path}: INVALID trace — {e}", file=sys.stderr)
+        return 1
+    print(f"mrlint: {path}: valid trace ({len(events)} events)")
+    return 0

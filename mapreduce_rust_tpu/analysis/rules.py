@@ -1,0 +1,598 @@
+"""The mrlint rule set — one rule per bug class this repo actually shipped.
+
+Each rule's docstring names the incident it encodes (the PR that shipped
+the bug and the PR that hand-fixed it); the rule exists so the NEXT
+regression of that class is caught by ``python -m mapreduce_rust_tpu lint``
+in CI instead of by a human reading a heisenbug out of a crashed run.
+
+Rules are deliberately framework-specific: they know this repo's names
+(JobStats, ``_a2a_span``, ``Dictionary``, ``SHARD_MAP_NATIVE``) because
+the invariants are this framework's, not Python's. Precision beats recall:
+a rule that cries wolf gets baselined into silence, so every rule here is
+tuned to fire on the shipped bug pattern and stay quiet on the shipped
+fix pattern.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from mapreduce_rust_tpu.analysis.lint import (
+    Finding,
+    ancestors,
+    enclosing_class,
+    enclosing_function,
+    qualname,
+)
+
+
+class Rule:
+    """Base: subclasses set ``name``/``summary`` and implement ``run``."""
+
+    name = "rule"
+    summary = ""
+
+    def check(self, tree: ast.Module, src: str, path: str) -> list[Finding]:
+        return list(self.run(tree, src, path))
+
+    def run(self, tree: ast.Module, src: str, path: str) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, path: str, node: ast.AST, message: str) -> Finding:
+        return Finding(self.name, path, getattr(node, "lineno", 1),
+                       getattr(node, "col_offset", 0), message)
+
+
+def _last_segment(name: str) -> str:
+    return name.rsplit(".", 1)[-1]
+
+
+def _mentions(node: ast.AST, ident: str, substring: bool = False) -> bool:
+    """Does the subtree reference ``ident`` as a Name or Attribute?"""
+    for n in ast.walk(node):
+        cand = None
+        if isinstance(n, ast.Name):
+            cand = n.id
+        elif isinstance(n, ast.Attribute):
+            cand = n.attr
+        if cand is not None and (ident in cand if substring else cand == ident):
+            return True
+    return False
+
+
+def _kw(call: ast.Call, name: str) -> "ast.expr | None":
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _is_true(node: "ast.expr | None") -> bool:
+    return isinstance(node, ast.Constant) and node.value is True
+
+
+def _is_false(node: "ast.expr | None") -> bool:
+    return isinstance(node, ast.Constant) and node.value is False
+
+
+# ---------------------------------------------------------------------------
+
+
+class StatsOwnershipRule(Rule):
+    """Functions submitted to a thread pool must not mutate JobStats.
+
+    Incident: PR 2's first cut had host-map scan workers doing
+    ``stats.host_map_s += dt`` from pool threads; an orphaned scan
+    surviving an exception teardown then raced the unwound stream's stats
+    (and the += itself was a lost-update race). The fix made scan workers
+    pure and moved every stats write to the single consumer thread — this
+    rule keeps it that way.
+    """
+
+    name = "stats-ownership"
+    summary = "pool-submitted functions must not mutate JobStats/self.stats"
+
+    def run(self, tree, src, path):
+        submitted: dict[str, ast.Call] = {}
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = qualname(node.func)
+            arg = None
+            if _last_segment(fn) == "submit" and node.args:
+                arg = node.args[0]
+            elif _last_segment(fn) == "run_in_executor" and len(node.args) >= 2:
+                arg = node.args[1]
+            if arg is None:
+                continue
+            name = qualname(arg)
+            if name:
+                submitted.setdefault(_last_segment(name), node)
+            elif isinstance(arg, ast.Lambda):
+                yield from self._scan_body(arg, path, "<lambda>")
+        if not submitted:
+            return
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name in submitted:
+                yield from self._scan_body(node, path, node.name)
+
+    def _scan_body(self, fn, path, label):
+        for node in ast.walk(fn):
+            targets = []
+            if isinstance(node, ast.AugAssign):
+                targets = [node.target]
+            elif isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets = [node.target]
+            for t in targets:
+                q = qualname(t)
+                # stats.x / self.stats.x / outer.stats.x — any write through
+                # a segment named 'stats' is a consumer-thread privilege.
+                parts = q.split(".")
+                if len(parts) >= 2 and "stats" in parts[:-1]:
+                    yield self.finding(
+                        path, node,
+                        f"{label!r} is submitted to an executor but writes "
+                        f"{q!r} — JobStats is owned by the consumer thread; "
+                        "return the value and fold it there (an orphaned "
+                        "task must not race the unwound stream)",
+                    )
+
+
+class ExecutorTeardownRule(Rule):
+    """Every ThreadPoolExecutor must reach shutdown(wait=True,
+    cancel_futures=True) through a finally block or a with statement.
+
+    Incident: the host-map engine's pool was torn down with the default
+    ``shutdown(wait=False)`` on the exception path, abandoning an in-flight
+    scan that kept its memmap window alive past the stream's unwind (fixed
+    in PR 2); the ingest pool predates even that, leaking executors past
+    stream teardown in PR 1.
+    """
+
+    name = "executor-teardown"
+    summary = "executors need shutdown(wait=True, cancel_futures=True) in a finally/with"
+
+    _GOOD = "shutdown(wait=True, cancel_futures=True)"
+
+    def run(self, tree, src, path):
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _last_segment(qualname(node.func)) not in (
+                "ThreadPoolExecutor", "ProcessPoolExecutor"
+            ):
+                continue
+            if any(isinstance(a, ast.withitem) for a in ancestors(node)):
+                continue  # context manager owns the lifecycle
+            target = self._assign_target(node)
+            if target is None:
+                yield self.finding(
+                    path, node,
+                    "executor is neither stored nor used as a context manager "
+                    f"— it can never reach {self._GOOD}",
+                )
+                continue
+            q = qualname(target)
+            if isinstance(target, ast.Name):
+                ok, why = self._name_shutdown_in_finally(node, q)
+            else:
+                ok, why = self._attr_shutdown_anywhere(node, q)
+            if not ok:
+                yield self.finding(path, node, why)
+
+    def _assign_target(self, call):
+        parent = getattr(call, "mr_parent", None)
+        if isinstance(parent, ast.Assign) and len(parent.targets) == 1 \
+                and isinstance(parent.targets[0], (ast.Name, ast.Attribute)):
+            return parent.targets[0]
+        if isinstance(parent, ast.AnnAssign) \
+                and isinstance(parent.target, (ast.Name, ast.Attribute)):
+            return parent.target
+        return None
+
+    def _shutdown_calls(self, scope, q):
+        for n in ast.walk(scope):
+            if isinstance(n, ast.Call) and qualname(n.func) == f"{q}.shutdown":
+                yield n
+
+    def _good_kwargs(self, call) -> "str | None":
+        if _is_false(_kw(call, "wait")):
+            return "shutdown(wait=False) abandons running futures"
+        if not _is_true(_kw(call, "cancel_futures")):
+            return ("shutdown without cancel_futures=True leaves queued work "
+                    "to run against torn-down state")
+        return None
+
+    def _name_shutdown_in_finally(self, call, q):
+        scope = enclosing_function(call)
+        if scope is None:
+            scope = next(
+                (a for a in ancestors(call) if isinstance(a, ast.Module)), call
+            )
+        in_finally = []
+        anywhere = []
+        for n in ast.walk(scope):
+            if isinstance(n, ast.Try):
+                for stmt in n.finalbody:
+                    in_finally.extend(self._shutdown_calls(stmt, q))
+        anywhere.extend(self._shutdown_calls(scope, q))
+        if in_finally:
+            bad = [self._good_kwargs(c) for c in in_finally]
+            good = [b for b in bad if b is None]
+            if good:
+                return True, ""
+            return False, f"executor {q!r}: {bad[0]} — need {self._GOOD}"
+        if anywhere:
+            return False, (
+                f"executor {q!r} is shut down outside any finally block — an "
+                f"exception before the call leaks the pool; move "
+                f"{self._GOOD} into a finally (or use a with statement)"
+            )
+        return False, (
+            f"executor {q!r} never reaches shutdown — add a finally with "
+            f"{self._GOOD} (or use a with statement)"
+        )
+
+    def _attr_shutdown_anywhere(self, call, q):
+        # self.pool-style executors have a lifecycle method (close/teardown)
+        # elsewhere in the class; require the well-formed shutdown to exist
+        # anywhere in the owning class body.
+        scope = enclosing_class(call)
+        if scope is None:
+            scope = next(
+                (a for a in ancestors(call) if isinstance(a, ast.Module)), call
+            )
+        calls = list(self._shutdown_calls(scope, q))
+        if not calls:
+            return False, (
+                f"executor {q!r} never reaches shutdown anywhere in its "
+                f"owning class — add a teardown path calling {self._GOOD}"
+            )
+        if any(self._good_kwargs(c) is None for c in calls):
+            return True, ""
+        return False, (
+            f"executor {q!r}: {self._good_kwargs(calls[0])} — need {self._GOOD}"
+        )
+
+
+class TmpdirCleanupRule(Rule):
+    """mkdtemp must be paired with a try/finally rmtree in the same function.
+
+    Incident: the streaming egress once leaked ``egress-*`` part files into
+    the output dir when a partition sort failed mid-way (ADVICE r5); the fix
+    wrapped the whole egress phase in one try/finally rmtree. Spill-run
+    files got the same treatment via ``remove_run_files`` in run_job's
+    finally.
+    """
+
+    name = "tmpdir-cleanup"
+    summary = "mkdtemp needs a try/finally rmtree/remove_run_files in the same function"
+
+    _CLEANERS = ("rmtree", "remove_run_files", "cleanup")
+
+    def run(self, tree, src, path):
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _last_segment(qualname(node.func)) != "mkdtemp":
+                continue
+            scope = enclosing_function(node) or tree
+            cleaned = False
+            for n in ast.walk(scope):
+                if not isinstance(n, ast.Try):
+                    continue
+                for stmt in n.finalbody:
+                    for c in ast.walk(stmt):
+                        if isinstance(c, ast.Call) and _last_segment(
+                            qualname(c.func)
+                        ) in self._CLEANERS:
+                            cleaned = True
+            if not cleaned:
+                yield self.finding(
+                    path, node,
+                    "mkdtemp without a try/finally rmtree (or "
+                    "remove_run_files) in the same function — a failure "
+                    "between creation and cleanup leaks the directory into "
+                    "a shared output/work dir",
+                )
+
+
+class DonationSafetyRule(Rule):
+    """donate_argnums on a shard_map computation must sit behind the
+    native-shard_map guard.
+
+    Incident: donating state buffers into the pre-0.6 experimental
+    ``shard_map`` corrupts the jaxlib 0.4.x CPU client heap (observed as a
+    glibc "corrupted double-linked list" under the spill-heavy mesh merge,
+    fixed in PR 1 by gating donation on ``_SHARD_MAP_NATIVE``). Donation is
+    a memory optimization, never a correctness requirement — unguarded it
+    is a latent heap corruption on every jax<0.6 image.
+    """
+
+    name = "donation-safety"
+    summary = "donate_argnums near shard_map must be gated on SHARD_MAP_NATIVE"
+
+    def run(self, tree, src, path):
+        # decorator Call → the FunctionDef it decorates
+        deco_owner: dict[int, ast.AST] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in node.decorator_list:
+                    for sub in ast.walk(deco):
+                        deco_owner[id(sub)] = node
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _kw(node, "donate_argnums") is None and _kw(node, "donate_argnames") is None:
+                continue
+            if self._guarded(node):
+                continue
+            owner = deco_owner.get(id(node))
+            near_shard_map = False
+            if owner is not None and any(
+                _mentions(d, "shard_map") for d in owner.decorator_list
+            ):
+                near_shard_map = True
+            else:
+                stmt = self._nearest_statement(node)
+                if stmt is not None and _mentions(stmt, "shard_map"):
+                    near_shard_map = True
+            if near_shard_map:
+                yield self.finding(
+                    path, node,
+                    "donate_argnums applied to a shard_map computation "
+                    "without the native-shard_map guard — donating into "
+                    "jax.experimental.shard_map corrupts the jaxlib 0.4.x "
+                    "heap; gate it on SHARD_MAP_NATIVE (see "
+                    "parallel/shuffle.py) or drop the donation",
+                )
+
+    def _guarded(self, node) -> bool:
+        for anc in ancestors(node):
+            test = None
+            if isinstance(anc, (ast.If, ast.IfExp)):
+                test = anc.test
+            if test is not None and _mentions(test, "SHARD_MAP_NATIVE", substring=True):
+                return True
+        return False
+
+    def _nearest_statement(self, node):
+        for anc in ancestors(node):
+            if isinstance(anc, ast.stmt):
+                return anc
+        return None
+
+
+class A2APurityRule(Rule):
+    """No blocking readbacks inside ``_a2a_span`` blocks.
+
+    Incident: PR 2 found the mesh replay paths fetching spill counts
+    (``device_get`` → host block) INSIDE the ``mesh.all_to_all`` span, so
+    ``stats.all_to_all_s`` — the ICI numerator of the interconnect-vs-
+    compute split — was inflated with device-wait time and the multi-chip
+    attribution lied. The fix moved every blocking fetch after the span;
+    this rule pins it.
+    """
+
+    name = "a2a-purity"
+    summary = "no device_get/block_until_ready/asarray inside _a2a_span blocks"
+
+    _BLOCKING = (
+        "device_get", "block_until_ready", "asarray",
+        "local_rows", "local_batch", "to_host",
+    )
+
+    def run(self, tree, src, path):
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            if not any(
+                isinstance(item.context_expr, ast.Call)
+                and _last_segment(qualname(item.context_expr.func)).lstrip("_")
+                == "a2a_span"
+                for item in node.items
+            ):
+                continue
+            for stmt in node.body:
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.Call) and _last_segment(
+                        qualname(sub.func)
+                    ) in self._BLOCKING:
+                        yield self.finding(
+                            path, sub,
+                            f"{qualname(sub.func)!r} inside an _a2a_span "
+                            "block — blocking readbacks inflate "
+                            "stats.all_to_all_s (the ICI numerator) with "
+                            "device-wait time; fetch after the span and "
+                            "account it in device_wait_s",
+                        )
+
+
+class SpanBalanceRule(Rule):
+    """Tracer spans are entered only via ``with``.
+
+    A span entered by hand (``span = trace_span(...); span.__enter__()``)
+    that unwinds on an exception never closes, leaving the Chrome trace
+    with partially-overlapping spans that ``validate_events`` rejects and
+    Perfetto renders as garbage. The contextmanager protocol is the only
+    supported entry.
+    """
+
+    name = "span-balance"
+    summary = "trace_span/_a2a_span only as a with-statement context"
+
+    _SPANS = ("trace_span", "a2a_span")
+
+    def run(self, tree, src, path):
+        if path.endswith("runtime/trace.py"):
+            return  # the definition site manipulates spans by construction
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _last_segment(qualname(node.func)).lstrip("_") not in self._SPANS:
+                continue
+            parent = getattr(node, "mr_parent", None)
+            if isinstance(parent, ast.withitem):
+                continue
+            yield self.finding(
+                path, node,
+                f"{qualname(node.func)!r} outside a with statement — a "
+                "manually entered span that unwinds on exception leaves the "
+                "trace unbalanced (validate_events rejects it); use "
+                "'with ...:'",
+            )
+
+
+class SpilledDictApiRule(Rule):
+    """No ``in``/``.items()`` on a possibly-spilled Dictionary outside
+    runtime/dictionary.py.
+
+    Incident: after the bounded-memory dictionary tier landed, RAM-tier
+    point probes (``key in d``, ``d.items()``) silently answered from a
+    PARTIAL store once a budget flush had moved words to disk runs — PR 1
+    made both raise on spilled instances, and egress consumes
+    ``iter_sorted()``. This rule catches new probe sites before they trip
+    the runtime guard in a spill-heavy run nobody tests locally.
+
+    Precision: a name is Dictionary-typed if it is assigned from a
+    ``Dictionary(...)``-like constructor in the same scope (budget kwargs
+    present ⇒ spillable), or follows the repo convention of being named
+    exactly ``dictionary`` (provenance unknown ⇒ treated as spillable).
+    A budget-free local ``Dictionary()`` is provably RAM-only and exempt.
+    """
+
+    name = "spilled-dict-api"
+    summary = "no in/.items() on possibly-spilled Dictionary outside runtime/dictionary.py"
+
+    def run(self, tree, src, path):
+        if path.endswith("runtime/dictionary.py"):
+            return
+        scopes = [tree] + [
+            n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for scope in scopes:
+            yield from self._scan_scope(scope, path)
+
+    def _own_nodes(self, scope):
+        """Walk a scope without descending into nested function scopes."""
+        body = scope.body if isinstance(
+            scope, (ast.Module, ast.FunctionDef, ast.AsyncFunctionDef)
+        ) else [scope]
+        stack = list(body)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # nested scope boundary — it gets its own pass
+            yield n
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _scan_scope(self, scope, path):
+        spillable: dict[str, bool] = {}  # name → may be spilled
+        for n in self._own_nodes(scope):
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call) \
+                    and len(n.targets) == 1 and isinstance(n.targets[0], ast.Name):
+                ctor = qualname(n.value.func)
+                if _last_segment(ctor).endswith("Dictionary"):
+                    spillable[n.targets[0].id] = bool(
+                        n.value.args or n.value.keywords
+                    )
+        def is_risky(expr) -> "str | None":
+            q = qualname(expr)
+            if not q:
+                return None
+            if isinstance(expr, ast.Name):
+                if expr.id in spillable:
+                    return q if spillable[expr.id] else None
+                return q if expr.id == "dictionary" else None
+            # self.dictionary / worker.dictionary — unknown provenance
+            return q if _last_segment(q) == "dictionary" else None
+
+        for n in self._own_nodes(scope):
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr == "items":
+                name = is_risky(n.func.value)
+                if name:
+                    yield self._probe_finding(path, n, f"{name}.items()")
+            if isinstance(n, ast.Compare) and len(n.ops) == 1 \
+                    and isinstance(n.ops[0], (ast.In, ast.NotIn)):
+                name = is_risky(n.comparators[0])
+                if name:
+                    yield self._probe_finding(path, n, f"'in {name}'")
+
+    def _probe_finding(self, path, node, probe):
+        return self.finding(
+            path, node,
+            f"{probe} on a possibly-spilled Dictionary answers from the RAM "
+            "tier only (flushed words live in disk runs) — consume "
+            "iter_sorted() / lookup(), or prove it RAM-only "
+            "(runtime/dictionary.py owns the spilled API)",
+        )
+
+
+class JitInLoopRule(Rule):
+    """No jax.jit/pjit construction inside per-chunk / per-window loops.
+
+    Incident: the round-3 bench measured warm == cold because fresh jitted
+    closures were built per call — every chunk paid the trace. The fix
+    cached step fns at module level keyed by value (make_step_fns /
+    make_packed_merge_fn); constructing a jit inside a data loop recreates
+    exactly that bug, with a ~40 s XLA compile per iteration on TPU.
+    """
+
+    name = "jit-in-loop"
+    summary = "no jax.jit/pjit construction inside data loops"
+
+    _JITS = ("jit", "pjit")
+
+    def _is_jit_expr(self, node) -> bool:
+        if _last_segment(qualname(node)) in self._JITS:
+            return True
+        if isinstance(node, ast.Call):
+            fn = _last_segment(qualname(node.func))
+            if fn in self._JITS:
+                return True
+            if fn == "partial" and node.args \
+                    and _last_segment(qualname(node.args[0])) in self._JITS:
+                return True
+        return False
+
+    def _in_loop(self, node) -> bool:
+        return any(
+            isinstance(a, (ast.For, ast.AsyncFor, ast.While))
+            for a in ancestors(node)
+        )
+
+    def run(self, tree, src, path):
+        for node in ast.walk(tree):
+            hit = None
+            if isinstance(node, ast.Call) and self._is_jit_expr(node):
+                hit = node
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and any(
+                self._is_jit_expr(d) for d in node.decorator_list
+            ):
+                hit = node
+            if hit is not None and self._in_loop(hit):
+                yield self.finding(
+                    path, hit,
+                    "jax.jit/pjit constructed inside a loop — every "
+                    "iteration re-traces (and on TPU re-compiles, ~40 s); "
+                    "build the jitted fn once outside, or use a cached "
+                    "factory like make_step_fns",
+                )
+
+
+ALL_RULES: list[Rule] = [
+    StatsOwnershipRule(),
+    ExecutorTeardownRule(),
+    TmpdirCleanupRule(),
+    DonationSafetyRule(),
+    A2APurityRule(),
+    SpanBalanceRule(),
+    SpilledDictApiRule(),
+    JitInLoopRule(),
+]

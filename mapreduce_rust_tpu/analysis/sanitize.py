@@ -1,0 +1,156 @@
+"""Opt-in thread-ownership sanitizer — the dynamic half of mrlint.
+
+The static rules (rules.py) prove structure: a pool-submitted function
+contains no stats write, an executor reaches its shutdown. What they can't
+prove is aliasing — a callable that REACHES shared state through a closure
+chain, a Dictionary handed to a thread that wasn't supposed to own it, a
+scan arena crossing a fork. This module catches those at runtime:
+
+- ``SanitizedJobStats``: every attribute write asserts the writing thread
+  is registered (creator + explicitly registered writers, e.g. the ingest
+  producer). A scan worker mutating stats — the PR 2 bug class — raises
+  ``SanitizerError`` at the write site instead of corrupting counters.
+- ``SanitizedDictionary``: mutating methods assert the owner thread — the
+  fold-on-one-thread contract of the ingest/host-map engines, enforced.
+- native arena check (native/host.py calls ``check_arena_owner``): per-
+  thread scan scratch must never be observed by a different (pid, tid) —
+  the fork/handoff hazard thread-locals can't express.
+
+Enabled by ``Config.sanitize=True`` or ``MR_SANITIZE=1`` in the
+environment; the factories below return plain instances when disabled, so
+the hot path pays nothing. No jax import here (package rule).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from mapreduce_rust_tpu.runtime.dictionary import Dictionary
+from mapreduce_rust_tpu.runtime.metrics import JobStats
+
+_TRUTHY = ("1", "true", "on", "yes")
+
+
+class SanitizerError(RuntimeError):
+    """A thread-ownership invariant was violated (this is a bug in the
+    calling code, not a recoverable condition — it fires at the exact
+    write that would have raced)."""
+
+
+def sanitize_enabled(cfg=None) -> bool:
+    """True when the sanitizer is on for this process: ``MR_SANITIZE`` in
+    the environment (so a whole test suite can opt in without touching
+    configs) or ``Config.sanitize`` on the job."""
+    if os.environ.get("MR_SANITIZE", "").strip().lower() in _TRUTHY:
+        return True
+    return bool(cfg is not None and getattr(cfg, "sanitize", False))
+
+
+class SanitizedJobStats(JobStats):
+    """JobStats whose attribute writes are gated on a registered-writer set.
+
+    The creator thread is registered at construction; a legitimately
+    concurrent writer (the ingest producer, which owns bytes_in/chunks/
+    forced_cuts by design) announces itself with ``register_writer()`` —
+    the base JobStats carries the same method as a no-op, so production
+    code calls it unconditionally. Everything else that writes from an
+    unregistered thread is exactly the orphaned-pool-thread race the
+    PR 2 teardown fix buried, and raises here.
+
+    Still a real dataclass instance: ``dataclasses.asdict`` (the manifest
+    path) and ``stats.phase(...)`` work unchanged — ``_writers`` is not a
+    dataclass field, so it never leaks into telemetry.
+    """
+
+    def __init__(self) -> None:
+        object.__setattr__(self, "_writers", {threading.get_ident()})
+        super().__init__()
+
+    def register_writer(self) -> None:
+        self._writers.add(threading.get_ident())
+
+    def __setattr__(self, name, value):
+        writers = getattr(self, "_writers", None)
+        if writers is not None and threading.get_ident() not in writers:
+            raise SanitizerError(
+                f"JobStats.{name} written from thread "
+                f"{threading.current_thread().name!r}, which never "
+                "registered as a writer — stats are owned by the consumer "
+                "thread; pool-submitted work must return values, not "
+                "mutate shared state (mrlint rule: stats-ownership)"
+            )
+        object.__setattr__(self, name, value)
+
+
+class SanitizedDictionary(Dictionary):
+    """Dictionary whose mutating methods assert the owner thread.
+
+    The ingest and host-map engines fold scan results into the dictionary
+    on exactly one consumer thread (driver docstrings state it; this
+    enforces it). ``set_owner()`` hands the instance to another thread
+    explicitly — the only sanctioned transfer.
+    """
+
+    def __init__(self, *args, **kwargs) -> None:
+        self._owner = threading.get_ident()
+        super().__init__(*args, **kwargs)
+
+    def set_owner(self, ident: int | None = None) -> None:
+        self._owner = threading.get_ident() if ident is None else ident
+
+    def _assert_owner(self, what: str) -> None:
+        if threading.get_ident() != self._owner:
+            raise SanitizerError(
+                f"Dictionary.{what} called from thread "
+                f"{threading.current_thread().name!r}, but the dictionary "
+                "is owned by another thread — scan workers return results; "
+                "only the consumer thread folds them (use set_owner() for "
+                "an explicit handoff)"
+            )
+
+    def add_words(self, words):
+        self._assert_owner("add_words")
+        return super().add_words(words)
+
+    def add_scanned(self, words, keys):
+        self._assert_owner("add_scanned")
+        return super().add_scanned(words, keys)
+
+    def add_scanned_raw(self, raw, ends, keys):
+        self._assert_owner("add_scanned_raw")
+        return super().add_scanned_raw(raw, ends, keys)
+
+    def add_text(self, normalized):
+        self._assert_owner("add_text")
+        return super().add_text(normalized)
+
+    def merge(self, other):
+        self._assert_owner("merge")
+        return super().merge(other)
+
+
+def new_job_stats(cfg=None) -> JobStats:
+    """JobStats, sanitized when enabled — the driver/worker construction
+    point (one factory so the enablement check lives in one place)."""
+    return SanitizedJobStats() if sanitize_enabled(cfg) else JobStats()
+
+
+def new_dictionary(cfg=None, **kwargs) -> Dictionary:
+    """Dictionary, sanitized when enabled; kwargs pass through (budgets)."""
+    cls = SanitizedDictionary if sanitize_enabled(cfg) else Dictionary
+    return cls(**kwargs)
+
+
+def check_arena_owner(owner_pid: int, owner_tid: int) -> None:
+    """Called by native/host._buffers on arena reuse when sanitizing: a
+    scratch arena observed under a different (pid, tid) than the one that
+    allocated it means thread-local state crossed a fork or a handoff —
+    its contents are another context's scan results."""
+    if (os.getpid(), threading.get_ident()) != (owner_pid, owner_tid):
+        raise SanitizerError(
+            f"native scan arena allocated by (pid={owner_pid}, "
+            f"tid={owner_tid}) observed from (pid={os.getpid()}, "
+            f"tid={threading.get_ident()}) — arenas are per-thread scratch "
+            "and must never cross a fork or thread handoff"
+        )

@@ -129,6 +129,16 @@ class Config:
     resume: bool = False            # start from work_dir/driver.ckpt when it
                                     # matches this job's fingerprint
 
+    sanitize: bool = False          # opt-in thread-ownership sanitizer
+                                    # (analysis/sanitize.py): JobStats, the
+                                    # egress dictionary and the native scan
+                                    # arenas get ownership asserts — a
+                                    # cross-thread write raises at the write
+                                    # site instead of racing. MR_SANITIZE=1
+                                    # in the environment enables it for a
+                                    # whole process tree (e.g. the test
+                                    # suite) without touching configs.
+
     multihost_barrier_timeout_s: float = 120.0  # how long a multi-process
                                     # run waits at the dictionary-exchange
                                     # barrier for every peer's shard before

@@ -312,7 +312,16 @@ class Coordinator:
         except (ConnectionResetError, asyncio.IncompleteReadError, json.JSONDecodeError):
             pass
         finally:
+            # Full teardown, not just close(): wait_closed() reaps the
+            # transport so a burst of short-lived clients (renewal
+            # connections, probes) can't accumulate half-closed sockets in
+            # the event loop — same leak class as executor teardown
+            # (mrlint: executor-teardown), applied to the RPC plane.
             writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError, OSError):
+                pass
 
     async def serve(self) -> None:
         """Listen + poll loop: 1 Hz done() check, detector every

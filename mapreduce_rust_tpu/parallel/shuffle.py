@@ -48,7 +48,14 @@ except ImportError:  # older jax: the experimental home
     from jax.experimental.shard_map import shard_map
 
     _SHARD_MAP_NATIVE = False
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+#: Public form of the guard: True iff this jax exports shard_map natively
+#: (>= 0.6), where buffer donation into a shard_map'ed jit is supported.
+#: The mrlint `donation-safety` rule requires any donate_argnums near a
+#: shard_map to sit behind a test of this name — import it rather than
+#: re-deriving the probe.
+SHARD_MAP_NATIVE = _SHARD_MAP_NATIVE
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
 
 from mapreduce_rust_tpu.apps.base import App
 from mapreduce_rust_tpu.core.kv import KVBatch

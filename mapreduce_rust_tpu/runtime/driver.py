@@ -520,6 +520,10 @@ class _IngestStream:
                     return False
 
     def _produce(self, inputs, stats, doc_id_offset) -> None:
+        # This producer thread legitimately owns bytes_in/chunks/forced_cuts
+        # (disjoint from the consumer's fields); under the sanitizer it must
+        # say so, or its first write raises. No-op otherwise.
+        stats.register_writer()
         try:
             for i, path in enumerate(inputs):
                 doc = self._doc_ids[i] if self._doc_ids else doc_id_offset + i
@@ -1590,7 +1594,12 @@ def run_job(
         # budgeted run without them would compute everything and return
         # an empty table — silently discarding the job.
         raise ValueError("egress budgets require write_outputs=True")
-    stats = JobStats()
+    # Sanitize-aware construction (analysis/sanitize.py): plain instances
+    # unless Config.sanitize / MR_SANITIZE=1, in which case cross-thread
+    # writes to stats or dictionary raise at the write site.
+    from mapreduce_rust_tpu.analysis.sanitize import new_dictionary, new_job_stats
+
+    stats = new_job_stats(cfg)
     acc = HostAccumulator(
         app.combine_op,
         budget_bytes=(
@@ -1599,8 +1608,8 @@ def run_job(
         ),
         spill_dir=cfg.work_dir,
     )
-    dictionary = Dictionary(
-        budget_words=cfg.dictionary_budget_words, spill_dir=cfg.work_dir
+    dictionary = new_dictionary(
+        cfg, budget_words=cfg.dictionary_budget_words, spill_dir=cfg.work_dir
     )
     tracer = start_tracing() if cfg.trace_path else None
     output_files: list[str] = []

@@ -77,6 +77,13 @@ class JobStats:
     # scan threads at job end (native/host.arena_bytes): the memory price
     # of host_map_workers, flat per thread by construction
 
+    def register_writer(self) -> None:
+        """Sanitizer hook: announce the calling thread as a legitimate
+        concurrent writer (the ingest producer calls this — it owns
+        bytes_in/chunks/forced_cuts by design). No-op here; the sanitized
+        subclass (analysis/sanitize.SanitizedJobStats) records the thread
+        and rejects writes from any thread that never registered."""
+
     @property
     def gb_per_s(self) -> float:
         return self.bytes_in / self.wall_seconds / 1e9 if self.wall_seconds else 0.0

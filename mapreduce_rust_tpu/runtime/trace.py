@@ -141,7 +141,18 @@ class Tracer:
         return out
 
     def write(self, path: str) -> str:
-        """Serialize once, atomically (tmp + rename). Returns ``path``."""
+        """Serialize once, atomically (tmp + rename). Returns ``path``.
+
+        Under the sanitizer (MR_SANITIZE=1 / Config.sanitize) the buffer is
+        validated first — an unbalanced or ill-typed event stream fails at
+        the writer, naming the broken span, instead of shipping a trace
+        Perfetto renders as garbage. (Every producer — driver, worker,
+        coordinator — writes through here, so they all get the check.)
+        """
+        from mapreduce_rust_tpu.analysis.sanitize import sanitize_enabled
+
+        if sanitize_enabled():
+            validate_events(self.events())
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
         tmp = f"{path}.{self._pid}.tmp"
@@ -218,13 +229,18 @@ def per_process_path(path: str, tag: str) -> str:
 
 
 def validate_events(events: list[dict]) -> None:
-    """Structural validator for a Chrome trace-event list (the test and
-    ``stats`` consumers share it): required fields, and per-(pid, tid)
-    "X" spans either nest or are disjoint — never partially overlap, which
-    is what makes the Perfetto flame graph well-formed.
+    """Structural validator for a Chrome trace-event list (the test,
+    ``stats`` and ``lint --check-trace`` consumers share it): required
+    fields; per-(pid, tid) "X" spans either nest or are disjoint — never
+    partially overlap, which is what makes the Perfetto flame graph
+    well-formed; "B"/"E" duration pairs balance per thread (every E
+    matches the most recent open B of the same name, nothing left open);
+    and "C" counter samples carry only numeric values — Perfetto plots a
+    non-numeric gauge as silent garbage, so it is rejected here instead.
     """
     per_thread: dict = {}
-    for ev in events:
+    be_events: dict = {}  # (pid, tid) → [(ts, seq, ph, name)]
+    for seq, ev in enumerate(events):
         for field in ("name", "ph", "ts", "pid", "tid"):
             if field not in ev:
                 raise ValueError(f"event missing {field!r}: {ev}")
@@ -233,6 +249,45 @@ def validate_events(events: list[dict]) -> None:
                 raise ValueError(f"X event needs dur >= 0: {ev}")
             per_thread.setdefault((ev["pid"], ev["tid"]), []).append(
                 (ev["ts"], ev["ts"] + ev["dur"], ev["name"])
+            )
+        elif ev["ph"] in ("B", "E"):
+            be_events.setdefault((ev["pid"], ev["tid"]), []).append(
+                (ev["ts"], seq, ev["ph"], ev["name"])
+            )
+        elif ev["ph"] == "C":
+            args = ev.get("args")
+            if not args or not isinstance(args, dict):
+                raise ValueError(f"C event needs non-empty args: {ev}")
+            for k, v in args.items():
+                # bool is an int subclass but not a gauge sample.
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    raise ValueError(
+                        f"C event value {k}={v!r} is not numeric: {ev}"
+                    )
+    for key, evs in be_events.items():
+        # Emission order breaks ties at equal timestamps (stable sort), so
+        # a zero-duration B-then-E pair stays balanced.
+        evs.sort(key=lambda e: (e[0], e[1]))
+        open_spans: list[str] = []
+        for ts, _seq, ph, name in evs:
+            if ph == "B":
+                open_spans.append(name)
+            elif not open_spans:
+                raise ValueError(
+                    f"E event {name!r} at ts={ts} on thread {key} has no "
+                    "matching open B"
+                )
+            elif open_spans[-1] != name:
+                raise ValueError(
+                    f"E event {name!r} at ts={ts} on thread {key} closes "
+                    f"{open_spans[-1]!r} — B/E pairs must nest by name"
+                )
+            else:
+                open_spans.pop()
+        if open_spans:
+            raise ValueError(
+                f"unbalanced B/E spans on thread {key}: "
+                f"{open_spans!r} never closed"
             )
     for key, spans in per_thread.items():
         # Sort by start asc, end desc: a containing span precedes its

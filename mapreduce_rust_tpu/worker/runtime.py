@@ -90,7 +90,9 @@ class Worker:
 
     def _map_table(self, doc_id: int, path: str) -> tuple[dict, Dictionary]:
         """(key-pair → combined value, dictionary shard) for one input file."""
-        dictionary = Dictionary()
+        from mapreduce_rust_tpu.analysis.sanitize import new_dictionary
+
+        dictionary = new_dictionary(self.cfg)
         op = self.app.combine_op
         if self.engine == "device":
             return self._map_table_device(doc_id, path, dictionary)
@@ -157,12 +159,12 @@ class Worker:
         return table
 
     def _map_table_device(self, doc_id: int, path: str, dictionary: Dictionary):
+        from mapreduce_rust_tpu.analysis.sanitize import new_job_stats
         from mapreduce_rust_tpu.runtime.driver import HostAccumulator, _stream_single
-        from mapreduce_rust_tpu.runtime.metrics import JobStats
 
         acc = HostAccumulator(self.app.combine_op)
-        _stream_single(self.cfg, self.app, [path], JobStats(), acc, dictionary,
-                       doc_id_offset=doc_id)
+        _stream_single(self.cfg, self.app, [path], new_job_stats(self.cfg), acc,
+                       dictionary, doc_id_offset=doc_id)
         return acc.table, dictionary
 
     def run_map_task(self, tid: int) -> None:
@@ -193,8 +195,11 @@ class Worker:
         # Dictionary shards are partitioned by the same k1 % reduce_n route
         # as the spills, so reduce task r reads exactly its own words —
         # mirroring the mr-{m}-{r} protocol (src/mr/worker.rs:121).
+        # iter_sorted, not items(): it serves the WHOLE dictionary whether
+        # or not a budget flush spilled words to disk runs (items() raises
+        # on a spilled instance — mrlint rule spilled-dict-api caught this).
         dict_parts: dict[int, Dictionary] = {r: Dictionary() for r in range(reduce_n)}
-        for (k1, k2), word in dictionary.items():
+        for _packed, k1, k2, word in dictionary.iter_sorted():
             dict_parts[k1 % reduce_n]._word_of[(k1, k2)] = word
         for r, dp in dict_parts.items():
             dp.collisions = list(dictionary.collisions) if r == 0 else []
@@ -206,10 +211,11 @@ class Worker:
             self._run_reduce_task(tid)
 
     def _run_reduce_task(self, tid: int) -> None:
+        from mapreduce_rust_tpu.analysis.sanitize import new_dictionary
         from mapreduce_rust_tpu.runtime.driver import HostAccumulator
 
         acc = HostAccumulator(self.app.combine_op)
-        dictionary = Dictionary()
+        dictionary = new_dictionary(self.cfg)
         for m in range(len(self.inputs)):
             spill = self.work / f"mr-{m}-{tid}.npz"
             with np.load(spill) as z:

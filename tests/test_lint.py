@@ -1,0 +1,485 @@
+"""mrlint rule fixtures: every rule has at least one BAD snippet it must
+fire on (the shipped-bug pattern, distilled) and a GOOD snippet it must
+stay silent on (the shipped-fix pattern) — precision is the contract that
+keeps the linter from being baselined into silence (ISSUE 3).
+
+Also: inline-suppression mechanics (reasons are mandatory), the baseline
+file format, and the JSON output schema.
+"""
+
+import json
+import textwrap
+
+import pytest
+
+from mapreduce_rust_tpu.analysis.lint import (
+    lint_file,
+    lint_paths,
+    load_baseline,
+)
+
+
+def run_lint(tmp_path, src, name="snippet.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    findings, errors, suppressed = lint_file(str(p))
+    assert not errors, errors
+    return findings, suppressed
+
+
+def rules_fired(tmp_path, src, name="snippet.py"):
+    findings, _ = run_lint(tmp_path, src, name)
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# stats-ownership
+# ---------------------------------------------------------------------------
+
+def test_stats_ownership_fires_on_pool_submitted_mutation(tmp_path):
+    findings, _ = run_lint(tmp_path, """
+        def scan_window(item, stats):
+            stats.host_map_s += 1.0   # the PR 2 bug: worker mutates stats
+            return item
+
+        def engine(pool, stats, items):
+            for it in items:
+                pool.submit(scan_window, it, stats)
+    """)
+    assert [f.rule for f in findings] == ["stats-ownership"]
+    assert "consumer thread" in findings[0].message
+
+
+def test_stats_ownership_fires_on_self_stats_via_method(tmp_path):
+    assert rules_fired(tmp_path, """
+        class Stream:
+            def _work(self):
+                self.stats.chunks = self.stats.chunks + 1
+
+            def go(self, pool):
+                pool.submit(self._work)
+    """) == ["stats-ownership"]
+
+
+def test_stats_ownership_silent_on_pure_worker(tmp_path):
+    assert rules_fired(tmp_path, """
+        def scan_window(item):
+            return len(item)          # pure: returns, never mutates
+
+        def engine(pool, stats, items):
+            for it in items:
+                pool.submit(scan_window, it)
+            stats.host_map_s += 1.0   # consumer-thread fold is fine
+    """) == []
+
+
+def test_stats_ownership_silent_on_unsubmitted_mutator(tmp_path):
+    # Mutating stats is fine for functions that never enter a pool.
+    assert rules_fired(tmp_path, """
+        def consume(result, stats):
+            stats.chunks += 1
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# executor-teardown
+# ---------------------------------------------------------------------------
+
+def test_executor_teardown_fires_without_shutdown(tmp_path):
+    findings, _ = run_lint(tmp_path, """
+        from concurrent.futures import ThreadPoolExecutor
+
+        def engine(items):
+            pool = ThreadPoolExecutor(max_workers=4)
+            for it in items:
+                pool.submit(print, it)
+    """)
+    assert [f.rule for f in findings] == ["executor-teardown"]
+    assert "never reaches shutdown" in findings[0].message
+
+
+def test_executor_teardown_fires_on_shutdown_outside_finally(tmp_path):
+    findings, _ = run_lint(tmp_path, """
+        from concurrent.futures import ThreadPoolExecutor
+
+        def engine(items):
+            pool = ThreadPoolExecutor(max_workers=4)
+            for it in items:
+                pool.submit(print, it)
+            pool.shutdown(wait=True, cancel_futures=True)  # skipped on raise
+    """)
+    assert [f.rule for f in findings] == ["executor-teardown"]
+    assert "outside any finally" in findings[0].message
+
+
+def test_executor_teardown_fires_without_cancel_futures(tmp_path):
+    findings, _ = run_lint(tmp_path, """
+        from concurrent.futures import ThreadPoolExecutor
+
+        def engine(items):
+            pool = ThreadPoolExecutor(max_workers=4)
+            try:
+                for it in items:
+                    pool.submit(print, it)
+            finally:
+                pool.shutdown(wait=True)   # queued work still runs
+    """)
+    assert [f.rule for f in findings] == ["executor-teardown"]
+    assert "cancel_futures" in findings[0].message
+
+
+def test_executor_teardown_fires_on_attr_pool_without_teardown(tmp_path):
+    assert rules_fired(tmp_path, """
+        from concurrent.futures import ThreadPoolExecutor
+
+        class Stream:
+            def __init__(self):
+                self.pool = ThreadPoolExecutor(max_workers=2)
+    """) == ["executor-teardown"]
+
+
+def test_executor_teardown_good_patterns_are_silent(tmp_path):
+    assert rules_fired(tmp_path, """
+        from concurrent.futures import ThreadPoolExecutor
+
+        def ctx(items):
+            with ThreadPoolExecutor(max_workers=4) as pool:
+                for it in items:
+                    pool.submit(print, it)
+
+        def fin(items):
+            pool = ThreadPoolExecutor(max_workers=4)
+            try:
+                for it in items:
+                    pool.submit(print, it)
+            finally:
+                pool.shutdown(wait=True, cancel_futures=True)
+
+        class Stream:
+            def __init__(self):
+                self.pool = ThreadPoolExecutor(max_workers=2)
+
+            def close(self):
+                self.pool.shutdown(wait=True, cancel_futures=True)
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# tmpdir-cleanup
+# ---------------------------------------------------------------------------
+
+def test_tmpdir_cleanup_fires_without_finally(tmp_path):
+    assert rules_fired(tmp_path, """
+        import tempfile
+
+        def egress(out_dir):
+            tmpdir = tempfile.mkdtemp(prefix="egress-", dir=out_dir)
+            open(tmpdir + "/part-0", "wb").close()
+    """) == ["tmpdir-cleanup"]
+
+
+def test_tmpdir_cleanup_silent_with_finally_rmtree(tmp_path):
+    assert rules_fired(tmp_path, """
+        import shutil
+        import tempfile
+
+        def egress(out_dir):
+            tmpdir = tempfile.mkdtemp(prefix="egress-", dir=out_dir)
+            try:
+                open(tmpdir + "/part-0", "wb").close()
+            finally:
+                shutil.rmtree(tmpdir, ignore_errors=True)
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# donation-safety
+# ---------------------------------------------------------------------------
+
+def test_donation_safety_fires_on_unguarded_shard_map_donation(tmp_path):
+    findings, _ = run_lint(tmp_path, """
+        import functools
+        import jax
+        from jax.experimental.shard_map import shard_map
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        @functools.partial(shard_map, mesh=None, in_specs=None, out_specs=None)
+        def merge(state, update):
+            return state
+    """)
+    assert [f.rule for f in findings] == ["donation-safety"]
+    assert "SHARD_MAP_NATIVE" in findings[0].message
+
+
+def test_donation_safety_silent_when_guarded(tmp_path):
+    assert rules_fired(tmp_path, """
+        import functools
+        import jax
+        from jax.experimental.shard_map import shard_map
+
+        _SHARD_MAP_NATIVE = False
+        _maybe_donate = (
+            functools.partial(jax.jit, donate_argnums=(0,))
+            if _SHARD_MAP_NATIVE else jax.jit
+        )
+
+        @_maybe_donate
+        @functools.partial(shard_map, mesh=None, in_specs=None, out_specs=None)
+        def merge(state, update):
+            return state
+    """) == []
+
+
+def test_donation_safety_silent_on_plain_jit(tmp_path):
+    # Donation into a plain (non-shard_map) jit is supported everywhere.
+    assert rules_fired(tmp_path, """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def merge(state, update):
+            return state
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# a2a-purity
+# ---------------------------------------------------------------------------
+
+def test_a2a_purity_fires_on_readback_inside_span(tmp_path):
+    findings, _ = run_lint(tmp_path, """
+        import jax
+        import numpy as np
+
+        def run_round(stats, step):
+            with _a2a_span(stats, round=1):
+                out = step()
+                n = int(np.asarray(jax.device_get(out)).sum())
+            return n
+    """)
+    assert sorted({f.rule for f in findings}) == ["a2a-purity"]
+    assert len(findings) == 2  # asarray AND device_get
+
+
+def test_a2a_purity_silent_when_fetch_moved_after_span(tmp_path):
+    assert rules_fired(tmp_path, """
+        import jax
+        import numpy as np
+
+        def run_round(stats, step):
+            with _a2a_span(stats, round=1):
+                out = step()
+            n = int(np.asarray(jax.device_get(out)).sum())
+            return n
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# span-balance
+# ---------------------------------------------------------------------------
+
+def test_span_balance_fires_on_manual_span(tmp_path):
+    findings, _ = run_lint(tmp_path, """
+        from mapreduce_rust_tpu.runtime.trace import trace_span
+
+        def leaky():
+            span = trace_span("chunk")   # never balanced on an exception
+            span.__enter__()
+    """)
+    assert [f.rule for f in findings] == ["span-balance"]
+
+
+def test_span_balance_silent_on_with(tmp_path):
+    assert rules_fired(tmp_path, """
+        from mapreduce_rust_tpu.runtime.trace import trace_span
+
+        def fine(stats):
+            with trace_span("chunk", n=1):
+                pass
+            with _a2a_span(stats, round=2):
+                pass
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# spilled-dict-api
+# ---------------------------------------------------------------------------
+
+def test_spilled_dict_api_fires_on_budgeted_instance_probes(tmp_path):
+    findings, _ = run_lint(tmp_path, """
+        from mapreduce_rust_tpu.runtime.dictionary import Dictionary
+
+        def egress(work):
+            d = Dictionary(budget_words=4, spill_dir=work)
+            if (1, 2) in d:
+                return dict(d.items())
+    """)
+    assert [f.rule for f in findings] == ["spilled-dict-api"] * 2
+
+
+def test_spilled_dict_api_fires_on_unknown_provenance_convention_name(tmp_path):
+    # `dictionary` handed in from elsewhere may carry a budget — the exact
+    # shape of the worker shard-partition bug this rule caught.
+    assert rules_fired(tmp_path, """
+        def shard(dictionary, reduce_n):
+            return [(k, w) for k, w in dictionary.items()]
+    """) == ["spilled-dict-api"]
+
+
+def test_spilled_dict_api_silent_on_provably_ram_only(tmp_path):
+    assert rules_fired(tmp_path, """
+        from mapreduce_rust_tpu.runtime.dictionary import Dictionary
+
+        def shard(reduce_n):
+            d = Dictionary()          # no budget: cannot spill
+            d.add_words([b"x"])
+            return dict(d.items())
+
+        def plain_dicts(table):
+            return sorted(table.items())   # builtin dicts are not Dictionaries
+    """) == []
+
+
+def test_spilled_dict_api_silent_on_iter_sorted(tmp_path):
+    assert rules_fired(tmp_path, """
+        def egress(dictionary):
+            for _p, k1, k2, w in dictionary.iter_sorted():
+                yield k1, k2, w
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# jit-in-loop
+# ---------------------------------------------------------------------------
+
+def test_jit_in_loop_fires_on_call_and_decorator(tmp_path):
+    findings, _ = run_lint(tmp_path, """
+        import jax
+
+        def stream(chunks, step):
+            for c in chunks:
+                f = jax.jit(step)     # re-traces per chunk
+                f(c)
+
+        def stream2(chunks):
+            while chunks:
+                @jax.jit
+                def step(x):
+                    return x
+                step(chunks.pop())
+    """)
+    assert [f.rule for f in findings] == ["jit-in-loop"] * 2
+
+
+def test_jit_in_loop_silent_outside_loops_and_on_cached_factories(tmp_path):
+    assert rules_fired(tmp_path, """
+        import jax
+
+        def stream(chunks, step, app):
+            f = jax.jit(step)         # built once
+            for c in chunks:
+                fns = make_step_fns(app, 128)   # cached factory is fine
+                f(c)
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# suppression mechanics + output formats
+# ---------------------------------------------------------------------------
+
+BAD_SNIPPET = """
+    def shard(dictionary):
+        return list(dictionary.items())
+"""
+
+
+def test_inline_ignore_with_reason_suppresses(tmp_path):
+    findings, suppressed = run_lint(tmp_path, """
+        def shard(dictionary):
+            # mrlint: ignore[spilled-dict-api] -- provably RAM-only here
+            return list(dictionary.items())
+    """)
+    assert findings == [] and suppressed == 1
+
+
+def test_inline_ignore_without_reason_is_reported(tmp_path):
+    p = tmp_path / "snippet.py"
+    p.write_text(textwrap.dedent("""
+        def shard(dictionary):
+            # mrlint: ignore[spilled-dict-api]
+            return list(dictionary.items())
+    """))
+    findings, errors, _ = lint_file(str(p))
+    assert [f.rule for f in findings] == ["spilled-dict-api"]
+    assert [e.rule for e in errors] == ["bad-suppression"]
+
+
+def test_ignore_in_string_literal_does_not_suppress(tmp_path):
+    findings, suppressed = run_lint(tmp_path, """
+        MARKER = "# mrlint: ignore[spilled-dict-api] -- not a comment"
+
+        def shard(dictionary):
+            return list(dictionary.items())
+    """)
+    assert [f.rule for f in findings] == ["spilled-dict-api"]
+    assert suppressed == 0
+
+
+def test_baseline_suppresses_and_tracks_unused(tmp_path):
+    p = tmp_path / "legacy.py"
+    p.write_text(textwrap.dedent(BAD_SNIPPET))
+    baseline = [
+        {"rule": "spilled-dict-api", "path": "*legacy.py",
+         "reason": "grandfathered until the shard rewrite"},
+        {"rule": "jit-in-loop", "path": "*never.py", "reason": "unused"},
+    ]
+    report = lint_paths([str(p)], baseline)
+    assert report.ok and report.baselined == 1
+    assert [e["path"] for e in report.unused_baseline] == ["*never.py"]
+
+
+def test_baseline_requires_reasons(tmp_path):
+    bad = tmp_path / ".mrlint.json"
+    bad.write_text(json.dumps(
+        {"suppressions": [{"rule": "jit-in-loop", "path": "*"}]}
+    ))
+    with pytest.raises(ValueError, match="reason"):
+        load_baseline(str(bad))
+    good = tmp_path / "ok.json"
+    good.write_text(json.dumps({"suppressions": [
+        {"rule": "*", "path": "x.py", "reason": "because"},
+    ]}))
+    assert load_baseline(str(good))[0]["rule"] == "*"
+
+
+def test_json_report_schema(tmp_path):
+    p = tmp_path / "legacy.py"
+    p.write_text(textwrap.dedent(BAD_SNIPPET))
+    report = lint_paths([str(p)])
+    doc = report.to_dict()
+    assert doc["tool"] == "mrlint" and doc["schema"] == 1
+    assert doc["ok"] is False and doc["files_checked"] == 1
+    assert len(doc["rules"]) >= 8
+    (f,) = doc["findings"]
+    assert set(f) == {"rule", "path", "line", "col", "message"}
+    assert f["rule"] == "spilled-dict-api"
+    json.dumps(doc)  # machine-readable by construction
+
+
+def test_cli_exits_2_when_explicit_paths_match_nothing(tmp_path, capsys):
+    # A mistyped CI target must be a config error, never a clean pass.
+    from mapreduce_rust_tpu.__main__ import main
+
+    assert main(["lint", str(tmp_path / "nonexistent")]) == 2
+    assert "nothing checked" in capsys.readouterr().err
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert main(["lint", str(empty)]) == 2
+
+
+def test_parse_error_is_a_finding_not_a_crash(tmp_path):
+    p = tmp_path / "broken.py"
+    p.write_text("def broken(:\n")
+    report = lint_paths([str(p)])
+    assert not report.ok
+    assert [e.rule for e in report.parse_errors] == ["parse-error"]

@@ -1,0 +1,245 @@
+"""Thread-ownership sanitizer (analysis/sanitize.py): the dynamic half of
+mrlint. Unit semantics (a cross-thread JobStats write RAISES, a dictionary
+fold off the owner thread RAISES, registered writers are let through),
+end-to-end jobs under Config.sanitize (results stay exact, nothing trips
+on the shipped engines), and the suite-under-MR_SANITIZE=1 wiring the
+ISSUE 3 CI satellite asks for.
+"""
+
+import collections
+import dataclasses
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from mapreduce_rust_tpu.analysis.sanitize import (
+    SanitizedDictionary,
+    SanitizedJobStats,
+    SanitizerError,
+    new_dictionary,
+    new_job_stats,
+    sanitize_enabled,
+)
+from mapreduce_rust_tpu.config import Config
+from mapreduce_rust_tpu.core.normalize import reference_word_counts
+from mapreduce_rust_tpu.runtime.dictionary import Dictionary
+from mapreduce_rust_tpu.runtime.metrics import JobStats
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TEXTS = [
+    "the quick brown fox jumps over the lazy dog " * 40,
+    "pack my box with five dozen liquor jugs " * 30,
+]
+
+
+def _run_in_thread(fn):
+    """Run fn on a fresh thread, returning the exception it raised (or None)."""
+    box: list = [None]
+
+    def body():
+        try:
+            fn()
+        except BaseException as e:  # noqa: BLE001 — the test inspects it
+            box[0] = e
+
+    t = threading.Thread(target=body)
+    t.start()
+    t.join()
+    return box[0]
+
+
+# ---------------------------------------------------------------------------
+# unit semantics
+# ---------------------------------------------------------------------------
+
+def test_cross_thread_stats_write_raises():
+    stats = SanitizedJobStats()
+    stats.chunks += 1  # creator thread writes freely
+    err = _run_in_thread(lambda: setattr(stats, "host_map_s", 1.0))
+    assert isinstance(err, SanitizerError)
+    assert "stats-ownership" in str(err)
+    assert stats.host_map_s == 0.0  # the racing write never landed
+
+
+def test_registered_writer_is_allowed():
+    stats = SanitizedJobStats()
+
+    def producer():
+        stats.register_writer()   # the ingest-producer handshake
+        stats.bytes_in += 100
+        stats.chunks += 1
+
+    assert _run_in_thread(producer) is None
+    assert stats.bytes_in == 100 and stats.chunks == 1
+
+
+def test_base_jobstats_register_writer_is_noop():
+    stats = JobStats()
+    stats.register_writer()       # production code calls unconditionally
+    assert _run_in_thread(lambda: setattr(stats, "chunks", 5)) is None
+
+
+def test_sanitized_stats_stay_a_real_dataclass():
+    stats = SanitizedJobStats()
+    stats.bytes_in = 42
+    d = dataclasses.asdict(stats)
+    assert d["bytes_in"] == 42
+    assert "_writers" not in d    # telemetry never sees sanitizer state
+    with stats.phase("stream"):
+        pass
+    assert "stream" in stats.phase_seconds
+
+
+def test_cross_thread_dictionary_fold_raises():
+    d = SanitizedDictionary()
+    d.add_words([b"alpha"])       # owner thread folds freely
+    err = _run_in_thread(lambda: d.add_words([b"beta"]))
+    assert isinstance(err, SanitizerError)
+    assert "consumer thread" in str(err)
+    assert len(d) == 1            # the cross-thread fold never landed
+
+
+def test_dictionary_handoff_via_set_owner():
+    d = SanitizedDictionary()
+
+    def fold():
+        d.set_owner()             # adopt, then fold
+        d.add_words([b"beta"])
+
+    assert _run_in_thread(fold) is None
+    assert len(d) == 1
+
+
+def test_sanitized_dictionary_merge_checks_owner():
+    d = SanitizedDictionary()
+    other = Dictionary()
+    other.add_words([b"word"])
+    err = _run_in_thread(lambda: d.merge(other))
+    assert isinstance(err, SanitizerError)
+    d.merge(other)                # owner thread is fine
+    assert len(d) == 1
+
+
+# ---------------------------------------------------------------------------
+# enablement plumbing
+# ---------------------------------------------------------------------------
+
+def test_factories_respect_config_and_env(monkeypatch):
+    monkeypatch.delenv("MR_SANITIZE", raising=False)
+    assert type(new_job_stats(Config())) is JobStats
+    assert type(new_dictionary(Config())) is Dictionary
+    assert type(new_job_stats(Config(sanitize=True))) is SanitizedJobStats
+    assert type(new_dictionary(Config(sanitize=True))) is SanitizedDictionary
+    monkeypatch.setenv("MR_SANITIZE", "1")
+    assert sanitize_enabled() and type(new_job_stats(None)) is SanitizedJobStats
+    monkeypatch.setenv("MR_SANITIZE", "0")
+    assert not sanitize_enabled(Config())
+
+
+def test_cli_sanitize_flag_exports_env(monkeypatch):
+    # --sanitize must reach the env-only checkpoints (native arena check,
+    # Tracer.write validation) and child processes, not just Config.
+    monkeypatch.delenv("MR_SANITIZE", raising=False)
+    from mapreduce_rust_tpu.__main__ import _cfg
+
+    class Args:
+        sanitize = True
+        reduce_n = 4
+        chunk_mb = 4.0
+        device = "cpu"
+        profile_dir = None
+        host = "127.0.0.1"
+        port = 1040
+        input = "data"
+        pattern = "*.txt"
+        work = "mr-work"
+        output = "mr-out"
+
+    cfg = _cfg(Args())
+    assert cfg.sanitize and os.environ.get("MR_SANITIZE") == "1"
+    assert sanitize_enabled()  # the env-only call sites now agree
+
+
+def test_budget_kwargs_pass_through(tmp_path, monkeypatch):
+    monkeypatch.delenv("MR_SANITIZE", raising=False)
+    d = new_dictionary(Config(sanitize=True), budget_words=2,
+                       spill_dir=str(tmp_path))
+    d.add_words([b"a", b"b", b"c", b"d"])
+    assert d.spilled              # the budget tier works under the wrapper
+    assert sorted(w for *_k, w in d.iter_sorted()) == [b"a", b"b", b"c", b"d"]
+    d.remove_runs()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: the shipped engines run clean under the sanitizer
+# ---------------------------------------------------------------------------
+
+def _write_corpus(tmp_path):
+    d = tmp_path / "in"
+    d.mkdir(exist_ok=True)
+    for i, t in enumerate(TEXTS):
+        (d / f"doc-{i}.txt").write_bytes(t.encode())
+    return sorted(str(p) for p in d.glob("*.txt"))
+
+
+def _oracle():
+    total = collections.Counter()
+    for t in TEXTS:
+        total.update(reference_word_counts(t.encode()))
+    return {w.encode(): c for w, c in total.items()}
+
+
+@pytest.mark.parametrize("engine_kw", [
+    {},                                        # device-tokenize single chip
+    {"map_engine": "host"},                    # host-map fan-out engine
+    {"map_engine": "host", "host_map_workers": 2},
+    {"mesh_shape": 4, "merge_capacity": 1 << 12},  # mesh all_to_all
+])
+def test_run_job_exact_under_sanitizer(tmp_path, engine_kw):
+    from mapreduce_rust_tpu.runtime.driver import run_job
+
+    inputs = _write_corpus(tmp_path)
+    cfg = Config(
+        chunk_bytes=4096, device="cpu", sanitize=True,
+        input_dir=str(tmp_path / "in"),
+        work_dir=str(tmp_path / "work"), output_dir=str(tmp_path / "out"),
+        **engine_kw,
+    )
+    res = run_job(cfg, inputs)
+    assert res.table == _oracle()
+    assert type(res.stats) is SanitizedJobStats  # really ran sanitized
+
+
+def test_sanitizer_catches_injected_cross_thread_fold(tmp_path):
+    # Negative control for the end-to-end claim: a deliberately broken
+    # "engine" that folds from a worker thread trips the sanitizer.
+    from concurrent.futures import ThreadPoolExecutor
+
+    d = new_dictionary(Config(sanitize=True))
+    with ThreadPoolExecutor(max_workers=1) as pool:
+        fut = pool.submit(d.add_words, [b"oops"])
+        with pytest.raises(SanitizerError):
+            fut.result()
+
+
+# ---------------------------------------------------------------------------
+# CI satellite: the existing suite runs once under MR_SANITIZE=1
+# ---------------------------------------------------------------------------
+
+def test_fast_subset_of_suite_passes_under_mr_sanitize():
+    # A representative fast slice of the EXISTING suite under MR_SANITIZE=1:
+    # the dictionary/egress-tier tests exercise every Dictionary mutator and
+    # the spill tiers end-to-end. (The full not-slow suite under
+    # MR_SANITIZE=1 is this same wiring at CI scale.)
+    env = {**os.environ, "MR_SANITIZE": "1", "JAX_PLATFORMS": "cpu"}
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+         "tests/test_dictionary.py", "tests/test_egress_tiers.py",
+         "-m", "not slow"],
+        capture_output=True, text=True, timeout=600, cwd=REPO, env=env,
+    )
+    assert r.returncode == 0, (r.stdout[-3000:], r.stderr[-1000:])

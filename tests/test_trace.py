@@ -107,6 +107,54 @@ def test_validator_rejects_partial_overlap():
         validate_events([{"ph": "X", "ts": 0, "pid": 1, "tid": 1}])
 
 
+def test_validator_rejects_unbalanced_be_pairs():
+    # B/E duration pairs (foreign traces — ours emits X) must balance per
+    # thread: every E closes the most recent open B of the same name, and
+    # nothing stays open (ISSUE 3 satellite: traces are checkable artifacts).
+    base = {"pid": 1, "tid": 1}
+    ok = [
+        dict(base, ph="B", name="a", ts=0.0),
+        dict(base, ph="B", name="b", ts=1.0),
+        dict(base, ph="E", name="b", ts=2.0),
+        dict(base, ph="E", name="a", ts=3.0),
+    ]
+    validate_events(ok)  # balanced nesting passes
+    with pytest.raises(ValueError, match="never closed"):
+        validate_events(ok[:2])  # both spans left open
+    with pytest.raises(ValueError, match="no matching open B"):
+        validate_events([dict(base, ph="E", name="a", ts=0.0)])
+    with pytest.raises(ValueError, match="nest by name"):
+        validate_events([
+            dict(base, ph="B", name="a", ts=0.0),
+            dict(base, ph="B", name="b", ts=1.0),
+            dict(base, ph="E", name="a", ts=2.0),  # closes over open "b"
+        ])
+    # Balance is per thread: an E on another thread cannot close this B —
+    # both sides are reported broken (left-open here, orphan E there).
+    with pytest.raises(ValueError, match="no matching open B|never closed"):
+        validate_events([
+            dict(base, ph="B", name="a", ts=0.0),
+            {"pid": 1, "tid": 2, "ph": "E", "name": "a", "ts": 1.0},
+        ])
+
+
+def test_validator_rejects_non_numeric_counter_values():
+    base = {"pid": 1, "tid": 1, "ph": "C", "name": "gauge", "ts": 0.0}
+    validate_events([dict(base, args={"depth": 3, "load": 0.5})])
+    for bad in ({"depth": "three"}, {"depth": None}, {"depth": True}, {}):
+        with pytest.raises(ValueError, match="C event"):
+            validate_events([dict(base, args=bad)])
+    with pytest.raises(ValueError, match="C event"):
+        validate_events([{k: v for k, v in base.items()}])  # args absent
+
+
+def test_tracer_counter_samples_validate():
+    tr = start_tracing()
+    tr.counter("host_map.inflight", scans=3, merges=2)
+    stop_tracing()
+    validate_events(tr.events())
+
+
 def test_disabled_tracing_is_inert_and_cheap():
     assert active_tracer() is None
     n = 20_000
@@ -124,16 +172,22 @@ def test_disabled_tracing_is_inert_and_cheap():
 def test_enabled_span_cost_supports_2pct_budget():
     tr = start_tracing()
     n = 10_000
-    t0 = time.perf_counter()
-    for _ in range(n):
-        with trace_span("op"):
-            pass
-    dt = time.perf_counter() - t0
+    # Best-of-3 rounds: the metric is the tracer's intrinsic cost, not the
+    # CI host's momentary load — one descheduled slice mid-loop was enough
+    # to flake the single-round form, while a real per-span regression
+    # slows every round.
+    best = float("inf")
+    for _round in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with trace_span("op"):
+                pass
+        best = min(best, time.perf_counter() - t0)
     stop_tracing()
-    assert len(tr) == n
+    assert len(tr) == 3 * n
     # Spans are per-chunk/per-round (>= ~10 ms of real work each); at
     # <100µs a span stays far under the 2% overhead acceptance budget.
-    assert dt / n < 100e-6, f"enabled span cost {dt / n * 1e6:.2f}µs"
+    assert best / n < 100e-6, f"enabled span cost {best / n * 1e6:.2f}µs"
 
 
 # ---- end-to-end traces ----
