@@ -610,6 +610,26 @@ def device_leg(path: str) -> None:
                       "stats": stats_to_dict(s)}))
 
 
+def _partial_trace_note(child_env: dict) -> str:
+    """Observability pointer for a failed/killed leg: the traced subprocess
+    runs with the flight recorder armed (run_job does it whenever
+    trace_path is set), so a timeout/SIGKILL leaves an atomic
+    ``*.partial.json`` snapshot — name it in the error instead of making
+    the operator rediscover it."""
+    tp = child_env.get("BENCH_TRACE")
+    if not tp:
+        return ""
+    from mapreduce_rust_tpu.runtime.trace import partial_path
+
+    pp = partial_path(tp)
+    if os.path.exists(pp):
+        return (
+            f"; flight recorder kept {pp} — stitch it with "
+            f"`python -m mapreduce_rust_tpu trace merge merged.json {pp}`"
+        )
+    return ""
+
+
 def _run_device_leg(corpus: pathlib.Path, timeout_s: int, env: dict | None,
                     init_timeout_s: int | None = None,
                     mode: str = "--device-leg"):
@@ -681,11 +701,15 @@ def _run_device_leg(corpus: pathlib.Path, timeout_s: int, env: dict | None,
                 return None, (
                     f"device backend init: no heartbeat within {init_timeout_s}s "
                     "(wedged accelerator plugin?)"
+                    + _partial_trace_note(child_env)
                 )
         try:
             proc.wait(timeout=timeout_s)
         except subprocess.TimeoutExpired:
-            return None, f"device leg timed out after {timeout_s}s"
+            return None, (
+                f"device leg timed out after {timeout_s}s"
+                + _partial_trace_note(child_env)
+            )
     finally:
         if proc.poll() is None:
             proc.kill()
@@ -725,7 +749,10 @@ def _run_device_leg(corpus: pathlib.Path, timeout_s: int, env: dict | None,
                 parsed["stats_source"] = "run_manifest"
             return parsed, None
     tail = ("".join(err_chunks) or out).strip().splitlines()
-    return None, f"device leg rc={proc.returncode}: {tail[-1] if tail else 'no output'}"
+    return None, (
+        f"device leg rc={proc.returncode}: {tail[-1] if tail else 'no output'}"
+        + _partial_trace_note(child_env)
+    )
 
 
 def _load_leg_manifest(path, t_start: float, pid: int):
@@ -970,11 +997,40 @@ def main() -> None:
         )
 
 
+def _lint_counts() -> dict:
+    """Run the backend-free mrlint analyzer and reduce its JSON report to
+    the counts a BENCH trajectory diffs (a regressing rule shows up in the
+    manifest, ROADMAP leftover). Best-effort: a broken linter is itself a
+    recorded fact, never a lost bench."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "mapreduce_rust_tpu", "lint",
+             "--format", "json"],
+            capture_output=True, text=True, timeout=120, cwd=str(REPO),
+        )
+        doc = json.loads(r.stdout)
+        return {
+            "ok": doc.get("ok"),
+            "exit_code": r.returncode,
+            "findings": len(doc.get("findings", [])),
+            "files_checked": doc.get("files_checked"),
+            "rules": len(doc.get("rules", [])),
+            "suppressed_inline": doc.get("suppressed_inline"),
+            "suppressed_baseline": doc.get("suppressed_baseline"),
+            "unused_baseline_entries": len(
+                doc.get("unused_baseline_entries", [])
+            ),
+        }
+    except Exception as e:
+        return {"error": repr(e)}
+
+
 def _write_bench_manifest(result: dict, dev, base_gbs) -> None:
     """One manifest.json per bench run — config, platform, git rev, the
-    measured leg's full JobStats, probe outcomes, trace path — so BENCH
-    rounds read structured state instead of scraping log tails. Best
-    effort: a manifest failure must never cost the stdout JSON line."""
+    measured leg's full JobStats, probe outcomes, trace path, mrlint
+    counts — so BENCH rounds read structured state instead of scraping log
+    tails. Best effort: a manifest failure must never cost the stdout JSON
+    line."""
     try:
         from mapreduce_rust_tpu.runtime import telemetry
 
@@ -994,6 +1050,7 @@ def _write_bench_manifest(result: dict, dev, base_gbs) -> None:
                 "kind": "bench_manifest",
                 "app": "word_count",
                 "result": result,
+                "lint": _lint_counts(),
                 "cpu_baseline_gbs": round(base_gbs, 4) if base_gbs else None,
                 # NOT trace_path: every traced leg (median repeats, fallback,
                 # reprobe) rewrites the same trace + run-manifest files, so

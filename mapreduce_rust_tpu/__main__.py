@@ -43,6 +43,16 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--reduce-n", type=int, default=4)
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=1040)
+    p.add_argument("--lease-timeout", type=float, default=5.0,
+                   dest="lease_timeout",
+                   help="seconds before an unrenewed task lease expires and "
+                   "the task re-executes (coordinator + workers must agree)")
+    p.add_argument("--lease-check-period", type=float, default=5.0,
+                   dest="lease_check_period",
+                   help="coordinator lease-detector scan period (seconds)")
+    p.add_argument("--renew-period", type=float, default=1.0,
+                   dest="renew_period",
+                   help="worker lease-renewal period (seconds)")
     p.add_argument("--chunk-mb", type=float, default=4.0)
     p.add_argument("--device", default="auto", choices=["auto", "tpu", "cpu"])
     p.add_argument("--profile-dir", default=None,
@@ -89,6 +99,9 @@ def _cfg(args, map_n: int = 1, worker_n: int = 1) -> Config:
         sanitize=getattr(args, "sanitize", False),
         host=args.host,
         port=args.port,
+        lease_timeout_s=getattr(args, "lease_timeout", 5.0),
+        lease_check_period_s=getattr(args, "lease_check_period", 5.0),
+        lease_renew_period_s=getattr(args, "renew_period", 1.0),
         input_dir=args.input,
         input_pattern=args.pattern,
         work_dir=args.work,
@@ -117,7 +130,18 @@ def _app(args):
     return get_app(args.app)
 
 
+def _arm_crash_dump(args) -> None:
+    """CLI processes that trace also dump their flight-recorder snapshot on
+    atexit/SIGTERM — installed here (not in library code) so embedded use
+    and tests never have their signal handlers stolen."""
+    if getattr(args, "trace", None):
+        from mapreduce_rust_tpu.runtime.trace import install_crash_dump
+
+        install_crash_dump()
+
+
 def cmd_run(args) -> int:
+    _arm_crash_dump(args)
     if getattr(args, "distributed", False):
         # Before ANY jax call: backend creation binds the process's client.
         from mapreduce_rust_tpu.parallel.distributed import initialize
@@ -139,6 +163,7 @@ def cmd_coordinator(args) -> int:
     from mapreduce_rust_tpu.coordinator.server import Coordinator
     from mapreduce_rust_tpu.runtime.chunker import list_inputs
 
+    _arm_crash_dump(args)
     inputs = list_inputs(args.input, args.pattern)
     if not inputs:
         print(f"no inputs matching {args.pattern} in {args.input}", file=sys.stderr)
@@ -152,6 +177,7 @@ def cmd_worker(args) -> int:
     from mapreduce_rust_tpu.runtime.chunker import list_inputs
     from mapreduce_rust_tpu.worker.runtime import Worker
 
+    _arm_crash_dump(args)
     inputs = list_inputs(args.input, args.pattern)
     cfg = _cfg(args, map_n=len(inputs))
     worker = Worker(cfg, app=_app(args), engine=args.engine)
@@ -197,6 +223,79 @@ def cmd_stats(args) -> int:
     for line in lines:
         print(line)
     return 0
+
+
+def cmd_trace(args) -> int:
+    """``trace merge <out> <traces...>``: stitch per-process trace files
+    (flight-recorder partials included) onto one timeline — the
+    coordinator's clock when RPC offsets exist, the wall clock otherwise —
+    and write a single Perfetto-loadable file. Backend-free."""
+    from mapreduce_rust_tpu.runtime.trace import merge_traces
+
+    if args.action != "merge":
+        print(f"unknown trace action {args.action!r}", file=sys.stderr)
+        return 2
+    import json
+
+    try:
+        summary = merge_traces(args.out, args.traces)
+    except (OSError, ValueError, KeyError, json.JSONDecodeError) as e:
+        print(f"trace merge: {e}", file=sys.stderr)
+        return 1
+    procs = summary["processes"]
+    print(
+        f"{summary['out']}: {summary['events']} events from "
+        f"{len(procs)} process(es) over {summary['span_s']:.3f}s "
+        f"(reference: {summary['reference']})"
+    )
+    for p in procs:
+        flag = " [partial]" if p["partial"] else ""
+        print(f"  pid {p['pid']:>7}  {p['tag']:<12} clock={p['clock_domain']}"
+              f"{flag}  {p['path']}")
+    return 0
+
+
+def cmd_watch(args) -> int:
+    """Live plain-text job view: polls the coordinator's ``stats`` RPC at
+    ``--interval`` (default 1 Hz) and repaints per-phase progress + lease
+    liveness until the job completes or the coordinator goes away."""
+    from mapreduce_rust_tpu.coordinator.server import CoordinatorClient, RpcTimeout
+    from mapreduce_rust_tpu.runtime.telemetry import format_progress
+
+    async def go() -> int:
+        client = CoordinatorClient(
+            args.host, args.port, timeout_s=max(args.interval * 5, 3.0)
+        )
+        try:
+            await client.connect(retries=args.connect_retries, delay=0.2)
+        except (OSError, RpcTimeout) as e:
+            print(f"watch: no coordinator at {args.host}:{args.port} ({e})",
+                  file=sys.stderr)
+            return 1
+        clear = sys.stdout.isatty() and not args.once
+        try:
+            while True:
+                try:
+                    rep = await client.call("stats")
+                except RpcTimeout as e:
+                    # Alive-but-not-answering is the wedge this PR's whole
+                    # timeout machinery exists to expose — it must never
+                    # render as "job finished" (exit 0).
+                    print(f"watch: coordinator not answering — wedged? ({e})",
+                          file=sys.stderr)
+                    return 1
+                except ConnectionError:
+                    print("watch: coordinator gone — job finished or stopped")
+                    return 0
+                text = format_progress(rep)
+                print(("\x1b[H\x1b[2J" + text) if clear else text, flush=True)
+                if args.once or (rep.get("progress") or {}).get("done"):
+                    return 0
+                await asyncio.sleep(args.interval)
+        finally:
+            await client.close()
+
+    return asyncio.run(go())
 
 
 def cmd_lint(args) -> int:
@@ -317,6 +416,35 @@ def main(argv: list[str] | None = None) -> int:
                    help="second manifest: print a field-level diff instead")
     p.add_argument("-v", "--verbose", action="store_true")
 
+    p = sub.add_parser(
+        "trace",
+        help="trace-file tooling: merge per-process traces onto one timeline",
+    )
+    p.add_argument("action", choices=["merge"],
+                   help="merge: stitch trace files (partials included) onto "
+                   "the coordinator clock and write one Perfetto-loadable "
+                   "timeline")
+    p.add_argument("out", help="output path for the merged trace")
+    p.add_argument("traces", nargs="+",
+                   help="per-process trace files (trace-coord.json, "
+                   "trace-w*.json, *.partial.json, driver traces)")
+    p.add_argument("-v", "--verbose", action="store_true")
+
+    p = sub.add_parser(
+        "watch",
+        help="live plain-text job view against a running coordinator "
+        "(polls the stats RPC)",
+    )
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=1040)
+    p.add_argument("--interval", type=float, default=1.0,
+                   help="poll period in seconds (default 1 Hz)")
+    p.add_argument("--once", action="store_true",
+                   help="print one snapshot and exit (scripting/tests)")
+    p.add_argument("--connect-retries", type=int, default=5,
+                   dest="connect_retries")
+    p.add_argument("-v", "--verbose", action="store_true")
+
     args = parser.parse_args(argv)
     args._parser = parser  # lets _app turn validation failures into usage errors
     logging.basicConfig(
@@ -330,6 +458,8 @@ def main(argv: list[str] | None = None) -> int:
         "merge": cmd_merge,
         "clean": cmd_clean,
         "stats": cmd_stats,
+        "trace": cmd_trace,
+        "watch": cmd_watch,
         "lint": cmd_lint,
     }[args.cmd](args)
 

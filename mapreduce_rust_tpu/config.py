@@ -154,6 +154,25 @@ class Config:
     lease_check_period_s: float = 5.0  # mrcoordinator.rs:47-52 (1 Hz x 5 ticks)
     lease_renew_period_s: float = 1.0  # mrworker.rs:141 (fixed: map side too)
     poll_retry_s: float = 1.0        # worker sleep on -2/-3 (mrworker.rs:52,58)
+    rpc_timeout_s: float = 15.0      # per-call deadline on the worker→
+                                    # coordinator RPC plane (~3× the lease
+                                    # check period): a wedged coordinator
+                                    # used to block a worker FOREVER inside
+                                    # readline() — the renewal loop then
+                                    # never even expired client-side. A
+                                    # timed-out call raises RpcTimeout (a
+                                    # RuntimeError, deliberately NOT a
+                                    # ConnectionError: the worker's
+                                    # "coordinator gone = job done" path
+                                    # must not swallow a wedge as success).
+    flight_record_period_s: float = 5.0  # traced processes rewrite an
+                                    # atomic {trace}.partial.json snapshot
+                                    # at most this often (and at >=512 new
+                                    # events), from consumer/poll loops —
+                                    # a SIGKILLed worker's timeline
+                                    # survives and `trace merge` accepts
+                                    # the partial. MR_FLIGHT_RECORD_S
+                                    # overrides (test hook).
 
     # ---- Paths ----
     input_dir: str = "data"
@@ -170,6 +189,10 @@ class Config:
             raise ValueError(f"unknown map_engine {self.map_engine!r}")
         if self.host_map_workers is not None and self.host_map_workers < 1:
             raise ValueError("host_map_workers must be >= 1 (or None for auto)")
+        if self.rpc_timeout_s <= 0:
+            raise ValueError("rpc_timeout_s must be positive")
+        if self.flight_record_period_s <= 0:
+            raise ValueError("flight_record_period_s must be positive")
 
     def effective_host_map_workers(self) -> int:
         """Resolved host-map scan worker count: the explicit knob, or

@@ -41,13 +41,56 @@ import time
 
 from mapreduce_rust_tpu.config import Config
 from mapreduce_rust_tpu.runtime.telemetry import JobReport, write_job_report
-from mapreduce_rust_tpu.runtime.trace import start_tracing, stop_tracing, trace_span
+from mapreduce_rust_tpu.runtime.trace import (
+    partial_path,
+    per_process_path,
+    start_tracing,
+    stop_tracing,
+    trace_flow,
+    trace_span,
+)
 
 log = logging.getLogger("mapreduce_rust_tpu.coordinator")
 
 NOT_READY = -2   # phase gate / registration barrier
 WAIT = -3        # all assigned, leases outstanding — straggler wait
 DONE = -1        # phase complete
+
+
+class RpcTimeout(RuntimeError):
+    """A control-plane RPC exceeded Config.rpc_timeout_s. Deliberately NOT
+    a ConnectionError: the worker treats a vanished coordinator as "job
+    complete", and a WEDGED coordinator must never be mistaken for that."""
+
+
+class ClockSync:
+    """NTP-style offset estimate to the coordinator's ``perf_counter``
+    clock, fed by RPC round trips: the coordinator stamps its monotonic
+    ``now`` into every response, the client brackets the call with its own
+    clock, and ``offset = server_now - (t0 + t1) / 2`` with uncertainty
+    ±RTT/2. The minimum-RTT sample wins (standard NTP filtering — the
+    tightest bracket has the least queueing noise). Lands in the worker
+    manifest and in the trace metadata, where ``trace merge`` uses it to
+    rebase the worker's timeline onto the coordinator's."""
+
+    def __init__(self) -> None:
+        self.offset_s: "float | None" = None
+        self.rtt_s: "float | None" = None
+        self.samples = 0
+
+    def add(self, offset_s: float, rtt_s: float) -> None:
+        self.samples += 1
+        if self.rtt_s is None or rtt_s < self.rtt_s:
+            self.offset_s, self.rtt_s = offset_s, rtt_s
+
+    def best(self) -> "dict | None":
+        if self.offset_s is None:
+            return None
+        return {
+            "offset_s": self.offset_s,
+            "rtt_s": self.rtt_s,
+            "samples": self.samples,
+        }
 
 
 class _Phase:
@@ -60,6 +103,8 @@ class _Phase:
         self.finished = False
         self.leases: dict[int, float] = {}
         self.lease_timeout_s = lease_timeout_s
+        self.reported: set[int] = set()        # tids with a completion report
+        self.last_activity: dict[int, float] = {}  # tid → last grant/renew
 
     def grant(self) -> int:
         """Next task id per the reference grant path (coordinator.rs:137-176):
@@ -75,7 +120,9 @@ class _Phase:
             if tid is None:
                 return WAIT  # all assigned, leases outstanding — stragglers
         self.assigned[tid] = True
-        self.leases[tid] = time.monotonic() + self.lease_timeout_s
+        now = time.monotonic()
+        self.leases[tid] = now + self.lease_timeout_s
+        self.last_activity[tid] = now
         return tid
 
     def renew(self, tid: int) -> bool:
@@ -83,11 +130,15 @@ class _Phase:
         race the reference asserts on (coordinator.rs:125,132)."""
         if tid not in self.leases:
             return False
-        self.leases[tid] = time.monotonic() + self.lease_timeout_s
+        now = time.monotonic()
+        self.leases[tid] = now + self.lease_timeout_s
+        self.last_activity[tid] = now
         return True
 
     def report_finish(self, tid: int) -> bool:
+        self.reported.add(tid)
         self.leases.pop(tid, None)
+        self.last_activity.pop(tid, None)
         # Finish iff all ids issued, nothing awaiting reassignment, and no
         # lease outstanding (coordinator.rs:252-258).
         if (
@@ -103,6 +154,7 @@ class _Phase:
         dead = [tid for tid, deadline in self.leases.items() if deadline <= now]
         for tid in dead:
             del self.leases[tid]
+            self.last_activity.pop(tid, None)
             self.assigned[tid] = False  # eligible for re-grant
         return dead
 
@@ -130,6 +182,7 @@ class Coordinator:
         # over the `stats` RPC and dumped as work_dir/job_report.json at
         # done(). Aggregate counters only (runtime/metrics.py doctrine).
         self.report = JobReport()
+        self._flow_finished: set[str] = set()  # flow ids already terminated
         self._journal_path = os.path.join(cfg.work_dir, "coordinator.journal")
         if resume:
             self._replay_journal()
@@ -182,6 +235,9 @@ class Coordinator:
             phase = self.map if phase_name == "map" else self.reduce
             if 0 <= tid < phase.n:
                 phase.assigned[tid] = True
+                phase.reported.add(tid)  # journaled = completed: a late
+                # duplicate report after resume must count as late, not
+                # re-journal
                 phase.next_id = max(phase.next_id, tid + 1)
         # Recompute finish flags; grant() then serves only the gaps.
         for phase in (self.map, self.reduce):
@@ -217,21 +273,29 @@ class Coordinator:
         log.info("worker %d registered (%d/%d)", wid, self.worker_count, self.cfg.worker_n)
         return wid
 
+    def _grant(self, phase: "_Phase", name: str) -> int:
+        tid = phase.grant()
+        if tid >= 0:
+            self.report.record_grant(name, tid)
+            # Flow chain start: the grant span forks an arrow the worker's
+            # task span steps and the finish-report RPC terminates. The
+            # attempt suffix makes a re-execution a SECOND chain.
+            trace_flow(
+                "task", "s",
+                f"{name}:{tid}:{self.report.attempts(name, tid)}",
+                phase=name, tid=tid,
+            )
+        return tid
+
     def get_map_task(self) -> int:
         if not self.prepare():
             return NOT_READY  # registration barrier (coordinator.rs:142-144)
-        tid = self.map.grant()
-        if tid >= 0:
-            self.report.record_grant("map", tid)
-        return tid
+        return self._grant(self.map, "map")
 
     def get_reduce_task(self) -> int:
         if not self.map.finished:
             return NOT_READY  # phase gate (coordinator.rs:183-185)
-        tid = self.reduce.grant()
-        if tid >= 0:
-            self.report.record_grant("reduce", tid)
-        return tid
+        return self._grant(self.reduce, "reduce")
 
     def renew_map_lease(self, tid: int) -> bool:
         ok = self.map.renew(tid)
@@ -243,25 +307,87 @@ class Coordinator:
         self.report.record_renewal("reduce", tid, ok)
         return ok
 
-    def report_map_task_finish(self, tid: int) -> bool:
-        done = self.map.report_finish(tid)
-        self.report.record_finish("map", tid)
-        self._journal("map", tid)
+    def _finish(self, phase: "_Phase", name: str, tid: int, attempt: int) -> bool:
+        # Idempotent per (phase, tid): the duplicate completion of a
+        # re-executed task (original + replacement both report) used to
+        # double-journal and double-count — now it lands as a distinct
+        # late_reports stat and journals exactly once (ISSUE 4 satellite).
+        first = tid not in phase.reported
+        done = phase.report_finish(tid)
+        self.report.record_finish(name, tid, late=not first)
+        fid = f"{name}:{tid}:{attempt or self.report.attempts(name, tid)}"
+        if fid not in self._flow_finished:
+            # Guard the flow chain's single-finish invariant even if two
+            # reports name the same attempt (validate_events rejects a
+            # chain continuing past its "f").
+            self._flow_finished.add(fid)
+            trace_flow("task", "f", fid, phase=name, tid=tid)
+        if first:
+            self._journal(name, tid)
+        return done
+
+    def report_map_task_finish(self, tid: int, attempt: int = 0) -> bool:
+        done = self._finish(self.map, "map", tid, attempt)
         log.info("map %d finished (phase done=%s)", tid, done)
         return done
 
-    def report_reduce_task_finish(self, tid: int) -> bool:
-        done = self.reduce.report_finish(tid)
-        self.report.record_finish("reduce", tid)
-        self._journal("reduce", tid)
+    def report_reduce_task_finish(self, tid: int, attempt: int = 0) -> bool:
+        done = self._finish(self.reduce, "reduce", tid, attempt)
         log.info("reduce %d finished (job done=%s)", tid, done)
         return done
 
     def stats(self) -> dict:
         """The 8th RPC: the live control-plane job report — task states,
-        re-executions, lease expiries, durations, RPC latencies. Plain
-        ints/floats, so it rides the same JSON transport as the sentinels."""
-        return self.report.to_dict()
+        re-executions, lease expiries, durations, RPC latencies — plus the
+        ``progress`` view `watch` renders. Plain ints/floats, so it rides
+        the same JSON transport as the sentinels."""
+        return {**self.report.to_dict(), "progress": self.progress()}
+
+    def progress(self) -> dict:
+        """Live per-phase issued/done/in-flight/expired counts plus lease
+        liveness from renewal recency: a lease with no grant/renewal inside
+        ~3 renew periods belongs to a worker that is wedged or dead — the
+        thing `watch` exists to show while the lease detector counts down."""
+        now = time.monotonic()
+        live_window = max(3 * self.cfg.lease_renew_period_s, 1.5)
+        phases: dict = {}
+        for name, ph in (("map", self.map), ("reduce", self.reduce)):
+            leases = {}
+            for tid, deadline in ph.leases.items():
+                last = ph.last_activity.get(tid)
+                since = round(now - last, 3) if last is not None else None
+                leases[str(tid)] = {
+                    "attempt": self.report.attempts(name, tid),
+                    "lease_remaining_s": round(deadline - now, 3),
+                    "since_activity_s": since,
+                    "live": since is not None and since <= live_window,
+                }
+            done = len(ph.reported)
+            phases[name] = {
+                "tasks_total": ph.n,
+                "issued": ph.next_id,
+                "done": done,
+                "in_flight": len(ph.leases),
+                "pending": max(ph.n - done - len(ph.leases), 0),
+                "expired": self.report.phase_expiries(name),
+                "late_reports": self.report.phase_late_reports(name),
+                "stale": sum(
+                    1 for lease in leases.values() if not lease["live"]
+                ),
+                "finished": ph.finished,
+                "leases": leases,
+            }
+        return {
+            "phase": "done" if self.done()
+            else ("reduce" if self.map.finished else "map"),
+            "done": self.done(),
+            "workers": {
+                "registered": self.worker_count,
+                "expected": self.cfg.worker_n,
+            },
+            "uptime_s": round(self.report.uptime_s(), 3),
+            "phases": phases,
+        }
 
     # ---- in-process methods (coordinator.rs:25-97) ----
 
@@ -306,7 +432,24 @@ class Coordinator:
                     with trace_span(f"rpc.{method}"):
                         result = getattr(self, method)(*req.get("params", []))
                     self.report.record_rpc(method, time.perf_counter() - t0)
-                    resp = {"id": req.get("id"), "result": result}
+                    # "now" is the NTP-style timestamp ClockSync brackets:
+                    # the coordinator's perf_counter — the clock its own
+                    # trace timestamps are measured against, which is what
+                    # lets `trace merge` rebase worker files onto it.
+                    resp = {
+                        "id": req.get("id"),
+                        "result": result,
+                        "now": time.perf_counter(),
+                    }
+                    if (
+                        method in ("get_map_task", "get_reduce_task")
+                        and isinstance(result, int) and result >= 0
+                    ):
+                        # The grant's attempt number rides back so the
+                        # worker can stamp its task span into the same
+                        # flow chain (still just small integers).
+                        phase = "map" if method == "get_map_task" else "reduce"
+                        resp["attempt"] = self.report.attempts(phase, result)
                 writer.write(json.dumps(resp).encode() + b"\n")
                 await writer.drain()
         except (ConnectionResetError, asyncio.IncompleteReadError, json.JSONDecodeError):
@@ -329,8 +472,14 @@ class Coordinator:
         the job completes."""
         # The coordinator honors Config.trace_path too: per-RPC spans (see
         # _handle) make the control-plane timeline inspectable in Perfetto
-        # next to the workers' and driver's traces.
-        tracer = start_tracing() if self.cfg.trace_path else None
+        # next to the workers' and driver's traces. The "coord" tag marks
+        # this file as the reference clock for `trace merge`.
+        tracer = start_tracing(tag="coord") if self.cfg.trace_path else None
+        if tracer is not None:
+            tracer.enable_flight_recorder(
+                partial_path(per_process_path(self.cfg.trace_path, "coord")),
+                period_s=self.cfg.flight_record_period_s,
+            )
         server = await asyncio.start_server(self._handle, self.cfg.host, self.cfg.port)
         log.info("coordinator on %s:%d (map_n=%d reduce_n=%d worker_n=%d)",
                  self.cfg.host, self.cfg.port, self.cfg.map_n, self.cfg.reduce_n, self.cfg.worker_n)
@@ -341,6 +490,10 @@ class Coordinator:
                 if time.monotonic() - last_check >= self.cfg.lease_check_period_s:
                     self.check_lease()
                     last_check = time.monotonic()
+                if tracer is not None:
+                    # Flight-recorder tick from the existing poll loop —
+                    # never the RPC hot path.
+                    tracer.maybe_snapshot()
             # Job done: dump the control-plane report where a BENCH probe
             # (or a human) finds structured state instead of log tails.
             try:
@@ -368,10 +521,26 @@ class Coordinator:
 
 
 class CoordinatorClient:
-    """Tiny JSON-RPC client used by workers (and tests)."""
+    """Tiny JSON-RPC client used by workers (and tests).
 
-    def __init__(self, host: str, port: int) -> None:
+    ``timeout_s`` bounds every connect attempt and every call: a wedged
+    coordinator (process alive, event loop stuck) used to block a worker
+    forever inside ``readline()`` — the renewal loop then never expired
+    client-side. A timed-out call raises :class:`RpcTimeout`.
+
+    ``sync`` (a :class:`ClockSync`) accumulates NTP-style offset samples
+    from the coordinator's ``now`` response stamps — share one instance
+    across a worker's clients so the renewal connection's chatty round
+    trips tighten the estimate the trace merge uses.
+    """
+
+    def __init__(self, host: str, port: int,
+                 timeout_s: "float | None" = None,
+                 sync: "ClockSync | None" = None) -> None:
         self.host, self.port = host, port
+        self.timeout_s = timeout_s
+        self.sync = sync
+        self.last_attempt = 0  # attempt number of the last task grant
         self._reader: asyncio.StreamReader | None = None
         self._writer: asyncio.StreamWriter | None = None
         self._next_id = 0
@@ -379,8 +548,18 @@ class CoordinatorClient:
     async def connect(self, retries: int = 50, delay: float = 0.1) -> None:
         for attempt in range(retries):
             try:
-                self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+                coro = asyncio.open_connection(self.host, self.port)
+                if self.timeout_s:
+                    coro = asyncio.wait_for(coro, self.timeout_s)
+                self._reader, self._writer = await coro
                 return
+            except asyncio.TimeoutError:
+                if attempt == retries - 1:
+                    raise RpcTimeout(
+                        f"connect to coordinator {self.host}:{self.port} "
+                        f"timed out after {self.timeout_s}s"
+                    ) from None
+                await asyncio.sleep(delay)
             except OSError:
                 if attempt == retries - 1:
                     raise
@@ -390,14 +569,35 @@ class CoordinatorClient:
         assert self._writer is not None, "connect() first"
         self._next_id += 1
         req = {"id": self._next_id, "method": method, "params": list(params)}
+        t0 = time.perf_counter()
         self._writer.write(json.dumps(req).encode() + b"\n")
-        await self._writer.drain()
-        line = await self._reader.readline()
+        try:
+            if self.timeout_s:
+                await asyncio.wait_for(self._writer.drain(), self.timeout_s)
+                line = await asyncio.wait_for(
+                    self._reader.readline(), self.timeout_s
+                )
+            else:
+                await self._writer.drain()
+                line = await self._reader.readline()
+        except asyncio.TimeoutError:
+            raise RpcTimeout(
+                f"coordinator RPC {method!r} timed out after "
+                f"{self.timeout_s}s (wedged coordinator?)"
+            ) from None
+        t1 = time.perf_counter()
         if not line:
             raise ConnectionResetError("coordinator closed")
         resp = json.loads(line)
         if "error" in resp:
             raise RuntimeError(resp["error"])
+        now = resp.get("now")
+        if now is not None and self.sync is not None:
+            # offset maps THIS process's perf_counter onto the
+            # coordinator's, assuming the server stamped mid-flight.
+            self.sync.add(now - (t0 + t1) / 2, t1 - t0)
+        if "attempt" in resp:
+            self.last_attempt = int(resp["attempt"])
         return resp["result"]
 
     async def close(self) -> None:

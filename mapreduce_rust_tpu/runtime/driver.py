@@ -75,6 +75,8 @@ from mapreduce_rust_tpu.runtime.dictionary import (
 )
 from mapreduce_rust_tpu.runtime.metrics import JobStats, log
 from mapreduce_rust_tpu.runtime.trace import (
+    maybe_snapshot,
+    partial_path,
     start_tracing,
     stop_tracing,
     trace_counter,
@@ -565,6 +567,7 @@ class _IngestStream:
             # Backpressure: each pending future pins a chunk-sized payload;
             # fold the oldest (blocking) once the backlog exceeds the pool.
             self._fold_done(block=len(self.scans) > 2 * self.workers + 4)
+            maybe_snapshot()  # flight-recorder tick: per chunk, off-hot-path
             yield chunk
 
     def close(self, abort: bool = False) -> None:
@@ -881,6 +884,7 @@ def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
         # Glue stops before drain: drain's blocking readback is already
         # accounted in device_wait_s and must not be double-counted.
         stats.host_glue_s += time.perf_counter() - t_glue
+        maybe_snapshot()  # flight-recorder tick: per window, consumer thread
         if len(pending) >= 2 * depth:
             drain(depth)
 
@@ -1611,7 +1615,15 @@ def run_job(
     dictionary = new_dictionary(
         cfg, budget_words=cfg.dictionary_budget_words, spill_dir=cfg.work_dir
     )
-    tracer = start_tracing() if cfg.trace_path else None
+    tracer = start_tracing(tag="driver") if cfg.trace_path else None
+    if tracer is not None:
+        # Flight recorder: the stream loops tick maybe_snapshot() per
+        # chunk/window, so a killed or wedged driver still leaves an
+        # atomic *.partial.json that `trace merge` accepts.
+        tracer.enable_flight_recorder(
+            partial_path(cfg.trace_path),
+            period_s=cfg.flight_record_period_s,
+        )
     output_files: list[str] = []
     table: dict = {}
 

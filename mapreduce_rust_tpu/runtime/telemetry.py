@@ -59,10 +59,32 @@ class JobReport:
                 "stale_renewals": 0,
                 "expiries": 0,
                 "reports": 0,
+                "late_reports": 0,
                 "first_grant_s": None,
                 "done_s": None,
             }
         return t
+
+    def attempts(self, phase: str, tid: int) -> int:
+        """How many times (phase, tid) has been granted — the attempt
+        number of the CURRENT grant, and the suffix of its flow id."""
+        t = self._tasks.get((phase, tid))
+        return t["grants"] if t is not None else 0
+
+    def phase_expiries(self, phase: str) -> int:
+        return sum(
+            t["expiries"] for (p, _tid), t in self._tasks.items() if p == phase
+        )
+
+    def phase_late_reports(self, phase: str) -> int:
+        return sum(
+            t["late_reports"]
+            for (p, _tid), t in self._tasks.items()
+            if p == phase
+        )
+
+    def uptime_s(self) -> float:
+        return time.monotonic() - self._t0
 
     def record_grant(self, phase: str, tid: int) -> None:
         t = self._task(phase, tid)
@@ -81,13 +103,20 @@ class JobReport:
     def record_expiry(self, phase: str, tid: int) -> None:
         self._task(phase, tid)["expiries"] += 1
 
-    def record_finish(self, phase: str, tid: int) -> None:
+    def record_finish(self, phase: str, tid: int, late: bool = False) -> None:
         # Update-only, like record_renewal: a finish report for a task this
         # incarnation never granted (journal-resume restart) must not
         # fabricate a completed-but-never-granted entry whose duration_s
         # would be null.
         t = self._tasks.get((phase, tid))
         if t is None:
+            return
+        if late:
+            # A duplicate completion (original + re-executed worker both
+            # reporting the same tid) is a DISTINCT stat, not a second
+            # "reports" tick: double-counting skewed task durations and
+            # completion totals (ISSUE 4 satellite).
+            t["late_reports"] += 1
             return
         t["reports"] += 1
         if t["done_s"] is None:
@@ -124,6 +153,7 @@ class JobReport:
                 "renewals": t["renewals"],
                 "stale_renewals": t["stale_renewals"],
                 "reports": t["reports"],
+                "late_reports": t["late_reports"],
                 "duration_s": duration,
                 "completed": t["done_s"] is not None,
             }
@@ -133,6 +163,7 @@ class JobReport:
                 "completed": sum(1 for t in tasks.values() if t["completed"]),
                 "re_executions": sum(t["re_executions"] for t in tasks.values()),
                 "expiries": sum(t["expiries"] for t in tasks.values()),
+                "late_reports": sum(t["late_reports"] for t in tasks.values()),
             }
             for phase, tasks in phases.items()
         }
@@ -158,6 +189,63 @@ class JobReport:
         n_rpc = sum(r["count"] for r in d["rpc"].values())
         parts.append(f"{n_rpc} RPCs")
         return "; ".join(parts)
+
+
+def format_progress(stats: dict) -> str:
+    """Plain-text live job view of a coordinator ``stats`` RPC response —
+    what the ``watch`` subcommand repaints at 1 Hz. Degrades gracefully on
+    a pre-progress coordinator (totals only)."""
+    prog = stats.get("progress") or {}
+    workers = prog.get("workers") or {}
+    lines = [
+        f"coordinator: phase {prog.get('phase', '?')}"
+        f" · workers {workers.get('registered', '?')}/{workers.get('expected', '?')}"
+        f" · up {prog.get('uptime_s', 0.0):.1f}s"
+    ]
+    totals = stats.get("totals") or {}
+    for name in ("map", "reduce"):
+        ph = (prog.get("phases") or {}).get(name)
+        if ph is None:
+            tot = totals.get(name)
+            if tot:
+                lines.append(
+                    f"  {name:<7} {tot['completed']}/{tot['tasks']} done"
+                )
+            continue
+        n = ph["tasks_total"]
+        done = ph["done"]
+        width = 24
+        filled = int(width * done / n) if n else width
+        bar = "#" * filled + "-" * (width - filled)
+        lines.append(
+            f"  {name:<7} [{bar}] {done}/{n} done · "
+            f"{ph['in_flight']} in-flight · {ph['pending']} pending · "
+            f"{ph['expired']} expired · {ph['late_reports']} late"
+        )
+        for tid, lease in sorted(
+            (ph.get("leases") or {}).items(), key=lambda kv: int(kv[0])
+        ):
+            since = lease.get("since_activity_s")
+            since_s = f"{since:.1f}s ago" if since is not None else "never"
+            state = "live" if lease.get("live") else "STALE"
+            lines.append(
+                f"    task {tid:>3}  attempt {lease['attempt']}  "
+                f"lease {lease['lease_remaining_s']:+.1f}s  "
+                f"renewed {since_s}  [{state}]"
+            )
+    rpc = stats.get("rpc") or {}
+    if rpc:
+        calls = sum(r["count"] for r in rpc.values())
+        total_s = sum(r["total_s"] for r in rpc.values())
+        max_ms = max(r["max_ms"] for r in rpc.values())
+        lines.append(
+            f"  rpc: {calls} calls · mean "
+            f"{total_s / calls * 1e3 if calls else 0.0:.2f} ms · "
+            f"max {max_ms:.2f} ms"
+        )
+    if prog.get("done"):
+        lines.append("  job complete")
+    return "\n".join(lines)
 
 
 def write_job_report(path: str, report: JobReport) -> str:

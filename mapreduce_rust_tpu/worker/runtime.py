@@ -41,12 +41,28 @@ import numpy as np
 from mapreduce_rust_tpu.apps import get_app
 from mapreduce_rust_tpu.apps.base import App
 from mapreduce_rust_tpu.config import Config
-from mapreduce_rust_tpu.coordinator.server import DONE, NOT_READY, WAIT, CoordinatorClient
+from mapreduce_rust_tpu.coordinator.server import (
+    DONE,
+    NOT_READY,
+    WAIT,
+    ClockSync,
+    CoordinatorClient,
+    RpcTimeout,
+)
 from mapreduce_rust_tpu.core.hashing import hash_words
 from mapreduce_rust_tpu.runtime.chunker import chunk_stream, list_inputs
 from mapreduce_rust_tpu.runtime.dictionary import Dictionary, extract_words
 from mapreduce_rust_tpu.runtime.telemetry import JobReport
-from mapreduce_rust_tpu.runtime.trace import start_tracing, stop_tracing, trace_span
+from mapreduce_rust_tpu.runtime.trace import (
+    maybe_snapshot,
+    partial_path,
+    per_process_path,
+    start_tracing,
+    stop_tracing,
+    trace_flow,
+    trace_instant,
+    trace_span,
+)
 
 log = logging.getLogger("mapreduce_rust_tpu.worker")
 
@@ -85,6 +101,11 @@ class Worker:
         # the coordinator's event loop, so comparing against the server-side
         # numbers in the `stats` RPC isolates where a slow RPC spends.
         self.report = JobReport()
+        # NTP-style offset to the coordinator's clock, shared by every
+        # client this worker opens (renewal connections included): lands in
+        # the manifest and trace metadata for `trace merge`.
+        self.sync = ClockSync()
+        self._attempts: dict[tuple[str, int], int] = {}  # (phase, tid) → n
 
     # ---- map/reduce engines ----
 
@@ -168,7 +189,14 @@ class Worker:
         return acc.table, dictionary
 
     def run_map_task(self, tid: int) -> None:
-        with trace_span("worker.map_task", tid=tid):
+        att = self._attempts.get(("map", tid), 1)
+        with trace_span("worker.map_task", tid=tid, attempt=att):
+            # The flow step links this span into the coordinator's grant →
+            # ... → finish-report chain; the instant survives in a flight-
+            # recorder partial even though the span itself is only recorded
+            # at task exit (a SIGKILLed attempt leaves the begin mark).
+            trace_flow("task", "t", f"map:{tid}:{att}", phase="map", tid=tid)
+            trace_instant("worker.task_begin", phase="map", tid=tid, attempt=att)
             self._run_map_task(tid)
 
     def _run_map_task(self, tid: int) -> None:
@@ -207,7 +235,10 @@ class Worker:
         log.info("map %d: %s → %d keys, %d dict words", tid, path, len(table), len(dictionary))
 
     def run_reduce_task(self, tid: int) -> None:
-        with trace_span("worker.reduce_task", tid=tid):
+        att = self._attempts.get(("reduce", tid), 1)
+        with trace_span("worker.reduce_task", tid=tid, attempt=att):
+            trace_flow("task", "t", f"reduce:{tid}:{att}", phase="reduce", tid=tid)
+            trace_instant("worker.task_begin", phase="reduce", tid=tid, attempt=att)
             self._run_reduce_task(tid)
 
     def _run_reduce_task(self, tid: int) -> None:
@@ -252,16 +283,41 @@ class Worker:
     def _phase_name(self, method: str) -> str:
         return "map" if "map" in method else "reduce"
 
-    async def _renewal_loop(self, client: CoordinatorClient, method: str, tid: int) -> None:
+    async def _renewal_loop(self, client: CoordinatorClient, method: str,
+                            tid: int, stop: asyncio.Event) -> None:
+        # ``stop`` backs up task cancellation: on Python < 3.12,
+        # asyncio.wait_for SWALLOWS a cancel that lands just as its inner
+        # future completes (bpo-42130) — with the per-call rpc timeout
+        # wrapping readline in wait_for, a renewal loop cancelled at
+        # exactly a response boundary would keep renewing forever, the
+        # lease would never expire, and the task's finish report would
+        # never be sent: a distributed deadlock. The flag makes the exit
+        # condition level-triggered instead of edge-triggered.
         try:
-            while True:
+            while not stop.is_set():
                 await asyncio.sleep(self.cfg.lease_renew_period_s)
+                if stop.is_set():
+                    return
                 ok = await self._call(client, method, tid)
+                if stop.is_set():
+                    return  # a swallowed cancel still exits here
                 self.report.record_renewal(self._phase_name(method), tid, bool(ok))
+                # Snapshot AFTER the renewal is on the wire: under GIL
+                # contention with the compute thread the snapshot's IO can
+                # take 100s of ms, and the heartbeat must never queue
+                # behind telemetry (a delayed renewal is a lease expiry).
+                maybe_snapshot()
                 if not ok:
                     return  # stale lease (already reported) — just stop
         except (asyncio.CancelledError, ConnectionResetError):
             pass
+        except RpcTimeout as e:
+            # A wedged coordinator: stop renewing — the lease expires
+            # server-side (if the coordinator ever recovers) and our own
+            # eventual finish report lands as a late_report. The task
+            # itself keeps computing; only the heartbeat is dead.
+            log.warning("renewal loop for %s %d stopped: %s",
+                        self._phase_name(method), tid, e)
 
     async def _run_phase(self, client: CoordinatorClient, get: str, renew: str,
                          report: str, run_task) -> None:
@@ -273,35 +329,62 @@ class Worker:
                 # Coordinator exited between our WAIT poll and this call —
                 # the job completed while we slept. A clean end, not a crash.
                 # (ConnectionError only: other OSErrors — fd exhaustion,
-                # network flaps — must surface, not fake success.)
+                # network flaps — must surface, not fake success. An
+                # RpcTimeout — wedged, not gone — propagates too.)
                 log.info("coordinator gone — assuming job complete")
                 return
             if tid == DONE:
                 return
             if tid in (NOT_READY, WAIT):
+                maybe_snapshot()
                 await asyncio.sleep(self.cfg.poll_retry_s)
                 continue
             self.report.record_grant(phase, tid)
+            # The grant response carried the coordinator's attempt number:
+            # the task span joins that attempt's flow chain.
+            self._attempts[(phase, tid)] = client.last_attempt or 1
             # Separate connection for renewals, like the reference's
             # spawned renewal task (mrworker.rs:70-94) — but paced.
-            renew_client = CoordinatorClient(self.cfg.host, self.cfg.port)
+            renew_client = CoordinatorClient(
+                self.cfg.host, self.cfg.port,
+                timeout_s=self.cfg.rpc_timeout_s, sync=self.sync,
+            )
             await renew_client.connect()
-            renewal = asyncio.create_task(self._renewal_loop(renew_client, renew, tid))
+            stop_renewal = asyncio.Event()
+            renewal = asyncio.create_task(
+                self._renewal_loop(renew_client, renew, tid, stop_renewal)
+            )
             try:
                 # Heavy compute off the event loop so renewals keep flowing.
                 await asyncio.get_running_loop().run_in_executor(None, run_task, tid)
             finally:
+                # Flag first, then cancel: see _renewal_loop on why cancel
+                # alone can be swallowed mid-RPC on Python < 3.12.
+                stop_renewal.set()
                 renewal.cancel()
                 await asyncio.gather(renewal, return_exceptions=True)
                 await renew_client.close()
-            await self._call(client, report, tid)
+            await self._call(client, report, tid,
+                             self._attempts.get((phase, tid), 0))
             self.report.record_finish(phase, tid)
+            maybe_snapshot()
 
     async def run(self) -> None:
         # The worker honors Config.trace_path/manifest_path like the driver
         # does, under per-process names (several workers share one Config).
-        tracer = start_tracing() if self.cfg.trace_path else None
-        client = CoordinatorClient(self.cfg.host, self.cfg.port)
+        tag = f"w{os.getpid()}"
+        tracer = start_tracing(tag=tag) if self.cfg.trace_path else None
+        if tracer is not None:
+            tracer.clock_sync = self.sync  # live object: snapshots carry
+            # whatever offset estimate exists at snapshot time
+            tracer.enable_flight_recorder(
+                partial_path(per_process_path(self.cfg.trace_path, tag)),
+                period_s=self.cfg.flight_record_period_s,
+            )
+        client = CoordinatorClient(
+            self.cfg.host, self.cfg.port,
+            timeout_s=self.cfg.rpc_timeout_s, sync=self.sync,
+        )
         await client.connect()
         try:
             wid = await client.call("get_worker_id")
@@ -329,5 +412,8 @@ class Worker:
                     "worker_id": self.worker_id,
                     "engine": self.engine,
                     "report": self.report.to_dict(),
+                    # NTP-style offset to the coordinator clock (offset ±
+                    # RTT/2): the stitcher's cross-process rebase evidence.
+                    "clock_sync": self.sync.best(),
                 },
             )

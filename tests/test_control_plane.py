@@ -190,29 +190,193 @@ def test_worker_report_records_tasks_and_rpc_latency(tmp_path):
         assert rep["rpc"][method]["count"] >= 1
 
 
+def test_duplicate_finish_is_idempotent_and_counted_late(tmp_path):
+    # ISSUE 4 satellite: original + re-executed worker both reporting the
+    # same tid used to double-journal and double-count — now the duplicate
+    # is a distinct late_reports stat, the journal gets exactly one line,
+    # and the recorded duration stays the FIRST completion's.
+    cfg = make_cfg(tmp_path, 2, worker_n=1)
+    c = Coordinator(cfg)
+    c.get_worker_id()
+    assert c.get_map_task() == 0
+    assert not c.report_map_task_finish(0, 1)
+    t = c.stats()["tasks"]["map"]["0"]
+    first_duration = t["duration_s"]
+    assert t["reports"] == 1 and t["late_reports"] == 0
+    # The duplicate (a re-executed straggler's report).
+    assert not c.report_map_task_finish(0, 2)
+    t = c.stats()["tasks"]["map"]["0"]
+    assert t["reports"] == 1          # not double-counted
+    assert t["late_reports"] == 1     # counted as its own thing
+    assert t["duration_s"] == first_duration
+    assert c.stats()["totals"]["map"]["late_reports"] == 1
+    journal = pathlib.Path(cfg.work_dir) / "coordinator.journal"
+    lines = journal.read_text().splitlines()
+    assert lines.count("map 0") == 1  # journaled exactly once
+
+
+def test_progress_view_tracks_lease_liveness(tmp_path):
+    # The stats RPC's progress view: per-phase issued/done/in-flight/
+    # expired plus lease liveness from renewal recency (ISSUE 4 tentpole).
+    cfg = make_cfg(tmp_path, 3, worker_n=1)
+    c = Coordinator(cfg)
+    c.get_worker_id()
+    assert c.get_map_task() == 0
+    assert c.get_map_task() == 1
+    c.report_map_task_finish(0, 1)
+    p = c.progress()
+    assert p["phase"] == "map" and p["done"] is False
+    assert p["workers"] == {"registered": 1, "expected": 1}
+    m = p["phases"]["map"]
+    assert m["tasks_total"] == 3 and m["issued"] == 2
+    assert m["done"] == 1 and m["in_flight"] == 1 and m["pending"] == 1
+    lease = m["leases"]["1"]
+    assert lease["attempt"] == 1 and lease["live"] is True
+    assert lease["lease_remaining_s"] > 0
+    # An expiry shows up in the per-phase counter and frees the lease.
+    c.map.leases[1] = 0.0  # force staleness
+    c.check_lease()
+    m = c.progress()["phases"]["map"]
+    assert m["expired"] == 1 and m["in_flight"] == 0 and m["pending"] == 2
+    # Fresh ids first (the reference grant order), then the expired task
+    # re-grants — and the view reports its bumped attempt.
+    assert c.get_map_task() == 2
+    assert c.get_map_task() == 1
+    assert c.progress()["phases"]["map"]["leases"]["1"]["attempt"] == 2
+    # format_progress renders it (the watch view).
+    from mapreduce_rust_tpu.runtime.telemetry import format_progress
+
+    text = format_progress(c.stats())
+    assert "phase map" in text and "1 expired" in text
+    assert "attempt 2" in text
+
+
+def test_rpc_timeout_surfaces_wedged_coordinator(tmp_path):
+    # ISSUE 4 satellite: a wedged coordinator (accepts, never answers)
+    # used to block a worker forever inside readline. With
+    # Config.rpc_timeout_s the call raises RpcTimeout — a RuntimeError,
+    # NOT a ConnectionError, so the worker's "coordinator gone = job
+    # done" path can never mistake a wedge for success.
+    import pytest
+
+    from mapreduce_rust_tpu.coordinator.server import RpcTimeout
+
+    async def go():
+        async def wedged(reader, writer):
+            await asyncio.sleep(30)  # accept, read nothing, answer nothing
+
+        server = await asyncio.start_server(wedged, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        client = CoordinatorClient("127.0.0.1", port, timeout_s=0.2)
+        await client.connect()
+        t0 = asyncio.get_running_loop().time()
+        try:
+            with pytest.raises(RpcTimeout, match="wedged"):
+                await client.call("get_map_task")
+            assert asyncio.get_running_loop().time() - t0 < 5.0
+            assert not isinstance(RpcTimeout("x"), ConnectionError)
+        finally:
+            await client.close()
+            server.close()
+            await server.wait_closed()
+
+    asyncio.run(go())
+
+
+def test_grant_response_carries_attempt_and_clock(tmp_path):
+    # The RPC plane still moves small integers, but the envelope now
+    # carries the coordinator's monotonic `now` (ClockSync samples it)
+    # and, on grants, the attempt number for flow linkage.
+    from mapreduce_rust_tpu.coordinator.server import ClockSync
+
+    write_corpus(tmp_path)
+    cfg = make_cfg(tmp_path, len(TEXTS), worker_n=1)
+
+    async def go():
+        coord = Coordinator(cfg)
+        serve = asyncio.create_task(coord.serve())
+        await asyncio.sleep(0.1)
+        sync = ClockSync()
+        client = CoordinatorClient(cfg.host, cfg.port, timeout_s=5.0, sync=sync)
+        await client.connect()
+        try:
+            await client.call("get_worker_id")
+            tid = await client.call("get_map_task")
+            assert tid == 0 and client.last_attempt == 1
+            best = sync.best()
+            assert best["samples"] >= 2 and best["rtt_s"] >= 0
+            # Same-host perf_counter clocks agree: the measured offset is
+            # bounded by the round trip itself (plus scheduler noise).
+            assert abs(best["offset_s"]) <= best["rtt_s"] + 0.05
+        finally:
+            await client.close()
+            serve.cancel()
+            await asyncio.gather(serve, return_exceptions=True)
+
+    asyncio.run(go())
+
+
+def test_watch_once_renders_live_progress(tmp_path, capsys):
+    # The watch subcommand: one poll against a live coordinator renders
+    # the plain-text job view and exits 0.
+    write_corpus(tmp_path)
+    cfg = make_cfg(tmp_path, len(TEXTS), worker_n=2)
+
+    async def go():
+        coord = Coordinator(cfg)
+        serve = asyncio.create_task(coord.serve())
+        await asyncio.sleep(0.1)
+        client = CoordinatorClient(cfg.host, cfg.port)
+        await client.connect()
+        await client.call("get_worker_id")
+        rc = await asyncio.get_running_loop().run_in_executor(None, _watch_once, cfg)
+        await client.close()
+        serve.cancel()
+        await asyncio.gather(serve, return_exceptions=True)
+        return rc
+
+    def _watch_once(cfg):
+        import subprocess
+        import sys
+
+        return subprocess.run(
+            [sys.executable, "-m", "mapreduce_rust_tpu", "watch",
+             "--port", str(cfg.port), "--once"],
+            capture_output=True, text=True, timeout=30,
+            env={"PYTHONPATH": str(pathlib.Path(__file__).resolve().parent.parent),
+                 "PATH": "/usr/bin:/bin"},
+        )
+
+    r = asyncio.run(go())
+    assert r.returncode == 0, r.stderr
+    assert "coordinator: phase map" in r.stdout
+    assert "workers 1/2" in r.stdout
+
+
+def test_watch_without_coordinator_fails_cleanly():
+    import subprocess
+    import sys
+
+    r = subprocess.run(
+        [sys.executable, "-m", "mapreduce_rust_tpu", "watch",
+         "--port", str(free_port()), "--once", "--connect-retries", "1"],
+        capture_output=True, text=True, timeout=30,
+        env={"PYTHONPATH": str(pathlib.Path(__file__).resolve().parent.parent),
+             "PATH": "/usr/bin:/bin"},
+    )
+    assert r.returncode == 1
+    assert "no coordinator" in r.stderr
+
+
 # ---- end-to-end over real sockets ----
 
-async def _run_cluster(cfg, n_workers, app=None, engine="host", kill_one=False):
+async def _run_cluster(cfg, n_workers, app=None, engine="host"):
     coord = Coordinator(cfg)
     serve = asyncio.create_task(coord.serve())
     await asyncio.sleep(0.1)
 
     ws = [Worker(cfg, app=app, engine=engine) for _ in range(n_workers)]
     workers = [asyncio.create_task(w.run()) for w in ws]
-    if kill_one:
-        # Deterministic kill window: wait until the victim HOLDS a lease
-        # (granted, unfinished task — its own report tells us), then kill
-        # it mid-flight (worker death; SURVEY.md §3-D recovery path). The
-        # lease expiry / re-execution the job report asserts on is then
-        # guaranteed, not a scheduling race.
-        loop = asyncio.get_running_loop()
-        deadline = loop.time() + 20
-        while not ws[0].report.in_flight() and loop.time() < deadline:
-            await asyncio.sleep(0.005)
-        assert ws[0].report.in_flight(), "victim never claimed a task"
-        workers[0].cancel()
-        await asyncio.gather(workers[0], return_exceptions=True)
-        workers = workers[1:]
     await asyncio.wait_for(asyncio.gather(*workers), timeout=60)
     await asyncio.wait_for(serve, timeout=30)
     return coord, ws
@@ -229,12 +393,43 @@ def test_cluster_survives_worker_death(tmp_path):
     # Both workers register (worker_n=2 barrier) and claim tasks; one dies
     # mid-task. Its lease must expire, the task re-grant to the survivor,
     # and the job complete with exact results (SURVEY.md §3-D).
+    # Deterministic kill window (the old in_flight() gate raced: a victim
+    # killed between its report RPC landing and the client-side record
+    # completed the job with zero expiries): the victim signals from
+    # INSIDE its map task and stalls past the lease timeout, so it always
+    # dies holding an unreported lease.
+    import threading
+    import time as _time
+
     write_corpus(tmp_path)
-    big = "repeat me many times " * 20000  # slow task: victim dies mid-map
-    write_corpus(tmp_path, TEXTS + [big])
-    cfg = make_cfg(tmp_path, len(TEXTS) + 1, worker_n=2)
-    coord, _ws = asyncio.run(_run_cluster(cfg, 2, kill_one=True))
-    assert read_outputs(cfg) == oracle(TEXTS + [big])
+    cfg = make_cfg(tmp_path, len(TEXTS), worker_n=2)
+    started = threading.Event()
+
+    class SlowMapVictim(Worker):
+        def run_map_task(self, tid: int) -> None:
+            started.set()
+            _time.sleep(1.5)  # long past the 1.0 s lease timeout
+            super().run_map_task(tid)
+
+    async def cluster():
+        coord = Coordinator(cfg)
+        serve = asyncio.create_task(coord.serve())
+        await asyncio.sleep(0.1)
+        victim = asyncio.create_task(SlowMapVictim(cfg, engine="host").run())
+        survivor = asyncio.create_task(Worker(cfg, engine="host").run())
+        deadline = asyncio.get_running_loop().time() + 30
+        while not started.is_set():
+            assert asyncio.get_running_loop().time() < deadline, \
+                "victim never started a map task"
+            await asyncio.sleep(0.02)
+        victim.cancel()
+        await asyncio.gather(victim, return_exceptions=True)
+        await asyncio.wait_for(survivor, timeout=60)
+        await asyncio.wait_for(serve, timeout=30)
+        return coord
+
+    coord = asyncio.run(cluster())
+    assert read_outputs(cfg) == oracle()
     # The fault is VISIBLE in the control-plane job report: the victim's
     # task (whichever phase it held a lease in when killed) shows >= 1
     # lease expiry and a re-execution, and the report agrees with the

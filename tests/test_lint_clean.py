@@ -51,6 +51,57 @@ def test_app_name_choices_match_registry():
     assert tuple(sorted(REGISTRY)) == tuple(sorted(_APP_NAMES))
 
 
+def test_check_trace_on_merged_trace(tmp_path):
+    """Tier-1 drift gate for the stitching path: two real processes write
+    traces through Tracer.write, `trace merge` stitches them, and
+    `lint --check-trace` must accept the result — so the merge writer and
+    the validator can never drift apart (ISSUE 4 satellite)."""
+    writer = (
+        "import sys\n"
+        "from mapreduce_rust_tpu.runtime.trace import (start_tracing, "
+        "stop_tracing, trace_span, trace_flow)\n"
+        "tr = start_tracing(tag=sys.argv[1])\n"
+        "with trace_span('rpc.get_map_task'):\n"
+        "    trace_flow('task', sys.argv[2], 'map:0:1')\n"
+        "stop_tracing()\n"
+        "tr.write(sys.argv[3])\n"
+    )
+    env = {"PYTHONPATH": REPO, "PATH": "/usr/bin:/bin"}
+    for tag, ph, name in (("coord", "s", "a.json"), ("w1", "t", "b.json")):
+        r = subprocess.run(
+            [sys.executable, "-c", writer, tag, ph, str(tmp_path / name)],
+            capture_output=True, text=True, timeout=60, env=env, cwd=REPO,
+        )
+        assert r.returncode == 0, r.stderr[-1000:]
+
+    merged = tmp_path / "merged.json"
+    r = subprocess.run(
+        [sys.executable, "-m", "mapreduce_rust_tpu", "trace", "merge",
+         str(merged), str(tmp_path / "a.json"), str(tmp_path / "b.json")],
+        capture_output=True, text=True, timeout=60, env=env, cwd=REPO,
+    )
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "2 process(es)" in r.stdout
+
+    r = subprocess.run(
+        [sys.executable, "-m", "mapreduce_rust_tpu", "lint",
+         "--check-trace", str(merged)],
+        capture_output=True, text=True, timeout=60, env=env, cwd=REPO,
+    )
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "valid trace" in r.stdout
+    # The merge CLI is backend-free, like every other tooling subcommand.
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; from mapreduce_rust_tpu.__main__ import main; "
+         f"rc = main(['trace', 'merge', {str(tmp_path / 'm2.json')!r}, "
+         f"{str(tmp_path / 'a.json')!r}]); "
+         "sys.exit(rc if rc else (3 if 'jax' in sys.modules else 0))"],
+        capture_output=True, text=True, timeout=60, env=env, cwd=REPO,
+    )
+    assert r.returncode == 0, (r.returncode, r.stdout[-500:], r.stderr[-500:])
+
+
 def test_check_trace_subcommand(tmp_path):
     from mapreduce_rust_tpu.__main__ import main
 

@@ -1,0 +1,394 @@
+"""Distributed run timeline (ISSUE 4 tentpole): cross-process trace
+stitching, flow-linked attempt chains, and the crash-safe flight recorder.
+
+The end-to-end tests run the REAL binaries (coordinator + workers as OS
+processes over TCP, each tracing), then stitch their files with `trace
+merge` and assert one validated timeline: distinct pid tracks, flow arrows
+grant → task → finish-report, cross-process skew bounded by the measured
+RPC round trip, and — after a SIGKILL — a recovered partial snapshot plus
+two visible attempt chains for the re-executed task.
+"""
+
+import json
+import os
+import pathlib
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from mapreduce_rust_tpu.runtime.trace import (
+    load_trace,
+    merge_traces,
+    partial_path,
+    validate_events,
+)
+
+REPO = str(pathlib.Path(__file__).resolve().parent.parent)
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _env() -> dict:
+    # JAX_PLATFORMS=cpu: the worker's manifest flush probes jax.devices()
+    # when jax is already imported — against a real (absent) TPU backend
+    # that probe retries instance metadata for ~minutes.
+    return {"PYTHONPATH": REPO, "PATH": "/usr/bin:/bin",
+            "JAX_PLATFORMS": "cpu"}
+
+
+def _common_args(tmp_path, port: int) -> list:
+    return [
+        "--input", str(tmp_path / "in"), "--output", str(tmp_path / "out"),
+        "--work", str(tmp_path / "work"), "--port", str(port),
+        "--reduce-n", "2",
+        "--trace", str(tmp_path / "trace.json"),
+        "--manifest", str(tmp_path / "manifest.json"),
+    ]
+
+
+def _write_docs(tmp_path, texts) -> None:
+    d = tmp_path / "in"
+    d.mkdir(exist_ok=True)
+    for i, t in enumerate(texts):
+        (d / f"doc-{i}.txt").write_bytes(t)
+
+
+def _spawn(kind: str, args: list, env: dict) -> subprocess.Popen:
+    return subprocess.Popen(
+        [sys.executable, "-m", "mapreduce_rust_tpu", kind, *args],
+        env=env, stderr=subprocess.DEVNULL,
+    )
+
+
+def _flow_chains(events: list) -> dict:
+    """flow id → set of phases present ({'s','t','f'} subsets)."""
+    chains: dict = {}
+    for e in events:
+        if e["ph"] in ("s", "t", "f"):
+            chains.setdefault(e["id"], set()).add(e["ph"])
+    return chains
+
+
+# ---- stitched multi-process run ----
+
+def test_trace_merge_multiprocess_run(tmp_path):
+    """Coordinator + 2 workers as OS processes, all tracing; `trace merge`
+    emits ONE validated timeline with per-process tracks, complete
+    grant→task→report flow chains, and grant-before-task ordering bounded
+    by the measured RTT (the acceptance criterion)."""
+    _write_docs(tmp_path, [
+        b"the quick brown fox jumps over the lazy dog " * 200,
+        b"pack my box with five dozen liquor jugs " * 200,
+        b"sphinx of black quartz judge my vow " * 200,
+    ])
+    port = free_port()
+    common = _common_args(tmp_path, port)
+    coord = _spawn("coordinator", ["--worker-n", "2", *common], _env())
+    workers = [
+        _spawn("worker", ["--engine", "host", *common], _env())
+        for _ in range(2)
+    ]
+    try:
+        for w in workers:
+            assert w.wait(timeout=60) == 0
+        assert coord.wait(timeout=30) == 0
+    finally:
+        for p in [coord, *workers]:
+            if p.poll() is None:
+                p.kill()
+
+    coord_trace = tmp_path / "trace-coord.json"
+    worker_traces = sorted(tmp_path.glob("trace-w*.json"))
+    worker_traces = [p for p in worker_traces if ".partial" not in p.name]
+    assert coord_trace.exists() and len(worker_traces) == 2
+    # Clean exits removed every flight-recorder partial.
+    assert not list(tmp_path.glob("*.partial.json"))
+
+    merged_path = tmp_path / "merged.json"
+    summary = merge_traces(str(merged_path), [str(coord_trace)] +
+                           [str(p) for p in worker_traces])
+    assert summary["reference"] == str(coord_trace)
+    events, md = load_trace(str(merged_path))
+    validate_events(events)
+    assert md["reference"]["tag"] == "coord"
+
+    # One pid track per process, named by tag (a worker that lost every
+    # grant race still gets its named track — it just carries no spans).
+    names = {e["args"]["name"] for e in events if e["ph"] == "M"}
+    assert any(n.startswith("coord") for n in names)
+    assert sum(1 for n in names if n.startswith("w")) == 2
+    assert len({p["pid"] for p in summary["processes"]}) == 3
+    pids = {e["pid"] for e in events if e["ph"] != "M"}
+    assert len(pids) >= 2  # coordinator + at least one active worker
+
+    # Flow chains: every map/reduce task's first attempt is fully linked
+    # (s on the coordinator grant, t in the worker task span, f on the
+    # finish-report RPC).
+    chains = _flow_chains(events)
+    for tid in range(3):
+        assert chains[f"map:{tid}:1"] == {"s", "t", "f"}
+    for tid in range(2):
+        assert chains[f"reduce:{tid}:1"] == {"s", "t", "f"}
+
+    # Cross-process skew bound: a task's grant (coordinator) precedes the
+    # worker's task step — a known-ordered pair — to within the measured
+    # RPC round trip of the worker that ran it.
+    rtts = {}
+    for p in worker_traces:
+        _evs, wmd = load_trace(str(p))
+        cs = wmd.get("clock_sync")
+        assert cs and cs["rtt_s"] >= 0 and cs["samples"] >= 1
+        rtts[wmd["pid"]] = cs["rtt_s"]
+    flow_events = [e for e in events if e["ph"] in ("s", "t")]
+    for tid in range(3):
+        fid = f"map:{tid}:1"
+        ts_s = next(e["ts"] for e in flow_events
+                    if e["id"] == fid and e["ph"] == "s")
+        t_ev = next(e for e in flow_events
+                    if e["id"] == fid and e["ph"] == "t")
+        # The merged pid may be remapped; bound by the worst worker RTT.
+        slack_us = max(rtts.values()) * 1e6 + 2000
+        assert t_ev["ts"] >= ts_s - slack_us, (
+            f"task step for {fid} precedes its grant by more than the RTT"
+        )
+
+    # Worker manifests carry the NTP-style clock sync for post-hoc audit.
+    manifests = [p for p in tmp_path.glob("manifest-w*.json")]
+    assert len(manifests) == 2
+    for p in manifests:
+        m = json.loads(p.read_text())
+        assert m["clock_sync"]["samples"] >= 1
+        assert m["clock_sync"]["rtt_s"] >= 0
+
+    # The tier-1 trace validator CLI accepts the merged artifact.
+    r = subprocess.run(
+        [sys.executable, "-m", "mapreduce_rust_tpu", "lint",
+         "--check-trace", str(merged_path)],
+        capture_output=True, text=True, timeout=60, env=_env(), cwd=REPO,
+    )
+    assert r.returncode == 0, r.stderr
+
+
+# ---- flight recorder: SIGKILL survival + attempt fork ----
+
+def test_flight_recorder_survives_sigkill(tmp_path):
+    """SIGKILL a worker mid-task: its flight-recorder partial survives, is
+    mergeable, and the re-executed task shows TWO attempt chains for the
+    same tid in the merged timeline (the acceptance criterion)."""
+    # Unique tokens make each map task CPU-heavy (~seconds): a wide,
+    # deterministic kill window without sleeps in product code.
+    docs = []
+    for i in range(3):
+        docs.append(b"".join(b"w%06x%02d " % (j, i) for j in range(150_000)))
+    _write_docs(tmp_path, docs)
+    port = free_port()
+    common = _common_args(tmp_path, port) + [
+        # Fast-but-tolerant control-plane timings: expiry + re-grant happen
+        # in seconds, yet the lease survives the multi-100ms GC/GIL pauses
+        # the heavy pure-Python map inflicts on the renewal heartbeat.
+        "--lease-timeout", "3.0", "--lease-check-period", "0.3",
+        "--renew-period", "0.3",
+    ]
+    env = {**_env(), "MR_FLIGHT_RECORD_S": "0.2"}
+    coord = _spawn("coordinator", ["--worker-n", "2", *common], _env())
+    victim = _spawn("worker", ["--engine", "host", *common], env)
+    survivor = _spawn("worker", ["--engine", "host", *common], env)
+    victim_partial = tmp_path / f"trace-w{victim.pid}.partial.json"
+    try:
+        # Deterministic kill window: wait until the victim's OWN partial
+        # snapshot shows it inside a map task (task_begin instant), then
+        # SIGKILL — no finally blocks, no atexit, nothing flushes.
+        deadline = time.monotonic() + 60
+        begun = False
+        while time.monotonic() < deadline and not begun:
+            if victim_partial.exists():
+                try:
+                    evs, md = load_trace(str(victim_partial))
+                except (ValueError, json.JSONDecodeError):
+                    evs, md = [], {}  # racing the atomic replace — retry
+                begun = any(e["name"] == "worker.task_begin" for e in evs)
+                if begun:
+                    assert md.get("partial") is True
+            if not begun:
+                time.sleep(0.02)
+        assert begun, "victim never began a task (or never snapshotted)"
+        os.kill(victim.pid, signal.SIGKILL)
+        victim.wait(timeout=10)
+
+        assert survivor.wait(timeout=180) == 0
+        assert coord.wait(timeout=60) == 0
+    finally:
+        for p in [coord, victim, survivor]:
+            if p.poll() is None:
+                p.kill()
+
+    # The partial SURVIVED the SIGKILL (no clean shutdown removed it).
+    assert victim_partial.exists()
+    part_events, part_md = load_trace(str(victim_partial))
+    assert part_md["partial"] is True and part_md["pid"] == victim.pid
+    validate_events(part_events)
+    assert any(e["name"] == "worker.task_begin" for e in part_events)
+
+    # Merge coordinator + survivor finals + the victim's partial.
+    survivor_trace = tmp_path / f"trace-w{survivor.pid}.json"
+    merged = tmp_path / "merged.json"
+    summary = merge_traces(str(merged), [
+        str(tmp_path / "trace-coord.json"),
+        str(survivor_trace),
+        str(victim_partial),
+    ])
+    events, _md = load_trace(str(merged))
+    validate_events(events)
+    assert any(p["partial"] for p in summary["processes"])
+
+    # The lease expiry re-granted the victim's task: the merged timeline
+    # shows TWO attempt chains for the same tid — attempt 1 started (and
+    # possibly stepped, in the partial) but never finished; attempt 2 ran
+    # to its finish-report.
+    chains = _flow_chains(events)
+    reexecuted = [fid for fid in chains if fid.endswith(":2")]
+    assert reexecuted, f"no re-executed attempt chain in {sorted(chains)}"
+    for fid in reexecuted:
+        assert "s" in chains[fid.rsplit(":", 1)[0] + ":1"], \
+            "attempt 1 chain missing its grant"
+    # At least one fork is the SIGKILLed attempt: granted, never finished
+    # (a slow-but-alive straggler's fork would carry a late "f"; the dead
+    # worker's cannot).
+    assert any(
+        "f" not in chains[fid.rsplit(":", 1)[0] + ":1"] for fid in reexecuted
+    ), "every attempt-1 chain finished — the killed attempt should not have"
+
+    # The control-plane report agrees: expiry + re-execution visible.
+    report = json.loads(
+        (tmp_path / "work" / "job_report.json").read_text()
+    )["report"]
+    assert sum(t["expiries"] for t in report["totals"].values()) >= 1
+    assert sum(t["re_executions"] for t in report["totals"].values()) >= 1
+
+
+# ---- merge unit semantics (no sockets) ----
+
+def _fake_trace(path, pid, tag, anchor_unix, events, clock_sync=None,
+                partial=False, anchor_perf=None):
+    md = {"pid": pid, "tag": tag, "anchor_unix_s": anchor_unix,
+          "anchor_perf_s": anchor_perf if anchor_perf is not None else 0.0}
+    if clock_sync:
+        md["clock_sync"] = clock_sync
+    if partial:
+        md["partial"] = True
+    path.write_text(json.dumps(
+        {"traceEvents": events, "metadata": md}
+    ))
+    return str(path)
+
+
+def test_merge_rebases_onto_wall_clock(tmp_path):
+    # Two processes whose epochs differ by 2.5 s of wall time: after the
+    # merge, event order follows the wall clock and the earliest event
+    # sits at ts 0.
+    a = _fake_trace(tmp_path / "a.json", 100, "coord", 1000.0, [
+        {"name": "early", "ph": "X", "ts": 0.0, "dur": 10.0, "pid": 100, "tid": 1},
+    ])
+    b = _fake_trace(tmp_path / "b.json", 200, "w1", 1002.5, [
+        {"name": "late", "ph": "X", "ts": 0.0, "dur": 10.0, "pid": 200, "tid": 1},
+    ])
+    out = tmp_path / "m.json"
+    merge_traces(str(out), [a, b])
+    events, _ = load_trace(str(out))
+    by_name = {e["name"]: e for e in events if e["ph"] == "X"}
+    assert by_name["early"]["ts"] == pytest.approx(0.0)
+    assert by_name["late"]["ts"] == pytest.approx(2.5e6)
+
+
+def test_merge_prefers_rpc_offset_over_wall(tmp_path):
+    # The worker's wall clock lies (says it started 100 s earlier) but its
+    # RPC-measured offset to the coordinator's perf clock is authoritative.
+    a = _fake_trace(tmp_path / "a.json", 100, "coord", 1000.0, [
+        {"name": "grant", "ph": "X", "ts": 0.0, "dur": 5.0, "pid": 100, "tid": 1},
+    ], anchor_perf=50.0)
+    b = _fake_trace(tmp_path / "b.json", 200, "w1", 900.0, [
+        {"name": "task", "ph": "X", "ts": 1000.0, "dur": 5.0, "pid": 200, "tid": 1},
+    ], anchor_perf=80.0, clock_sync={"offset_s": -30.0, "rtt_s": 0.001,
+                                     "samples": 9})
+    out = tmp_path / "m.json"
+    summary = merge_traces(str(out), [a, b])
+    domains = {p["tag"]: p["clock_domain"] for p in summary["processes"]}
+    assert domains == {"coord": "reference", "w1": "rpc"}
+    events, _ = load_trace(str(out))
+    by_name = {e["name"]: e for e in events if e["ph"] == "X"}
+    # worker perf 80.0 + offset -30.0 == coordinator perf 50.0 == epoch:
+    # the task's 1000 µs stays 1000 µs on the coordinator timeline.
+    assert by_name["task"]["ts"] == pytest.approx(1000.0)
+    assert by_name["grant"]["ts"] == pytest.approx(0.0)
+
+
+def test_merge_remaps_colliding_pids(tmp_path):
+    # A final trace merged next to its own stale partial (same pid) must
+    # not interleave two buffers on one track.
+    evs = [{"name": "a", "ph": "X", "ts": 0.0, "dur": 1.0, "pid": 7, "tid": 1}]
+    a = _fake_trace(tmp_path / "a.json", 7, "w1", 1000.0, evs)
+    b = _fake_trace(tmp_path / "b.json", 7, "w1", 1000.0, evs, partial=True)
+    out = tmp_path / "m.json"
+    summary = merge_traces(str(out), [a, b])
+    pids = {p["pid"] for p in summary["processes"]}
+    assert len(pids) == 2
+    events, _ = load_trace(str(out))
+    assert len({e["pid"] for e in events if e["ph"] == "X"}) == 2
+
+
+def test_merge_clamps_sub_rtt_flow_inversion(tmp_path):
+    # The rebase is only accurate to ±RTT/2: a worker's task step can land
+    # a few hundred µs BEFORE the coordinator's grant after rebasing. The
+    # merge clamps such cross-file inversions (within the measured
+    # tolerance) to the causal bound instead of failing validation and
+    # losing the artifact.
+    a = _fake_trace(tmp_path / "a.json", 100, "coord", 1000.0, [
+        {"name": "task", "ph": "s", "ts": 1000.0, "id": "map:0:1",
+         "pid": 100, "tid": 1},
+        {"name": "task", "ph": "f", "ts": 5000.0, "id": "map:0:1",
+         "pid": 100, "tid": 1},
+    ], anchor_perf=0.0)
+    # Worker clock error: its step rebases 400 µs before the grant; its
+    # measured RTT (1 ms) bounds the error, so the merge lifts it.
+    b = _fake_trace(tmp_path / "b.json", 200, "w1", 1000.0, [
+        {"name": "task", "ph": "t", "ts": 600.0, "id": "map:0:1",
+         "pid": 200, "tid": 1},
+    ], anchor_perf=0.0, clock_sync={"offset_s": 0.0, "rtt_s": 0.001,
+                                    "samples": 3})
+    out = tmp_path / "m.json"
+    merge_traces(str(out), [a, b])  # would raise without the clamp
+    events, _ = load_trace(str(out))
+    step = next(e for e in events if e["ph"] == "t")
+    start = next(e for e in events if e["ph"] == "s")
+    assert step["ts"] == start["ts"]
+    # Beyond tolerance the inversion is real (broken clock / writer bug)
+    # and still rejected.
+    c = _fake_trace(tmp_path / "c.json", 300, "w2", 1000.0, [
+        {"name": "task", "ph": "t", "ts": 0.0, "id": "map:0:1",
+         "pid": 300, "tid": 1},
+    ], anchor_perf=0.0, clock_sync={"offset_s": 0.0, "rtt_s": 0.0001,
+                                    "samples": 3})
+    with pytest.raises(ValueError, match="before its start"):
+        merge_traces(str(tmp_path / "m2.json"), [a, c])
+
+
+def test_merge_rejects_garbage(tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"nope": []}))
+    with pytest.raises(ValueError, match="traceEvents"):
+        merge_traces(str(tmp_path / "m.json"), [str(bad)])
+    with pytest.raises(ValueError, match="at least one"):
+        merge_traces(str(tmp_path / "m.json"), [])
+
+
+def test_partial_path_derivation():
+    assert partial_path("x.json") == "x.partial.json"
+    assert partial_path("dir/trace-w12.json") == "dir/trace-w12.partial.json"

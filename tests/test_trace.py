@@ -155,6 +155,106 @@ def test_tracer_counter_samples_validate():
     validate_events(tr.events())
 
 
+def test_validator_flow_and_metadata_phases():
+    # Flow events (ISSUE 4 tentpole): bound ids, chains that start at most
+    # once, never continue past their finish — but a start with no finish
+    # is LEGAL (that is what a crashed attempt looks like), and a fragment
+    # of only "t" steps is legal too (a worker trace before merging).
+    base = {"pid": 1, "tid": 1, "name": "task"}
+    ok = [
+        dict(base, ph="s", ts=0.0, id="map:0:1"),
+        dict(base, ph="t", ts=1.0, id="map:0:1"),
+        dict(base, ph="f", ts=2.0, id="map:0:1"),
+    ]
+    validate_events(ok)
+    validate_events(ok[:2])   # unterminated: crashed attempt
+    validate_events(ok[1:2])  # fragment: steps only
+    with pytest.raises(ValueError, match="bound id"):
+        validate_events([dict(base, ph="s", ts=0.0)])
+    with pytest.raises(ValueError, match="bound id"):
+        validate_events([dict(base, ph="t", ts=0.0, id="")])
+    with pytest.raises(ValueError, match="started twice"):
+        validate_events([ok[0], dict(base, ph="s", ts=3.0, id="map:0:1")])
+    with pytest.raises(ValueError, match="before its start"):
+        validate_events([dict(base, ph="t", ts=0.0, id="x"),
+                         dict(base, ph="s", ts=1.0, id="x")])
+    with pytest.raises(ValueError, match="continues after its finish"):
+        validate_events([dict(base, ph="f", ts=0.0, id="x"),
+                         dict(base, ph="t", ts=1.0, id="x")])
+    # Equal timestamps resolve s < t < f, so a merged grant/task pair that
+    # lands on the same microsecond stays a valid chain.
+    validate_events([dict(base, ph="t", ts=5.0, id="y"),
+                     dict(base, ph="s", ts=5.0, id="y")])
+    # Metadata events need args (Perfetto reads the track name from them).
+    validate_events([{"name": "process_name", "ph": "M", "ts": 0, "pid": 1,
+                      "tid": 0, "args": {"name": "w1"}}])
+    with pytest.raises(ValueError, match="M metadata"):
+        validate_events([{"name": "process_name", "ph": "M", "ts": 0,
+                          "pid": 1, "tid": 0}])
+
+
+def test_tracer_flow_events_and_metadata_roundtrip(tmp_path):
+    tr = start_tracing(tag="coord")
+    with trace_span("rpc.get_map_task"):
+        tr.flow("task", "s", "map:0:1", phase="map")
+    stop_tracing()
+    events = tr.events()
+    validate_events(events)
+    s = next(e for e in events if e["ph"] == "s")
+    assert s["id"] == "map:0:1" and s["args"]["phase"] == "map"
+
+    path = tmp_path / "t.json"
+    tr.write(str(path))
+    doc = json.load(open(path))
+    md = doc["metadata"]
+    assert md["tag"] == "coord" and md["pid"] == tr.metadata()["pid"]
+    assert md["anchor_unix_s"] > 0 and "anchor_perf_s" in md
+    with pytest.raises(ValueError):
+        tr.flow("task", "x", "bad")  # not a flow phase
+
+
+def test_flight_recorder_snapshot_lifecycle(tmp_path):
+    from mapreduce_rust_tpu.runtime.trace import partial_path
+
+    tr = start_tracing(tag="w1")
+    final = tmp_path / "trace.json"
+    part = partial_path(str(final))
+    tr.enable_flight_recorder(part, period_s=1e-6, min_new_events=1)
+    assert tr.maybe_snapshot() is None  # no events yet: nothing to write
+    with trace_span("op", n=1):
+        pass
+    assert tr.maybe_snapshot() == part
+    doc = json.load(open(part))
+    assert doc["metadata"]["partial"] is True
+    validate_events(doc["traceEvents"])
+    assert doc["traceEvents"][0]["name"] == "op"
+    # Not due again until new events arrive.
+    assert tr.maybe_snapshot() is None
+    tr.instant("mark")
+    assert tr.maybe_snapshot() == part
+    # force bypasses the due check (the atexit/SIGTERM dump path).
+    tr.instant("mark2")
+    assert tr.maybe_snapshot(force=True) == part
+    # The clean final write removes the stale partial.
+    tr.write(str(final))
+    stop_tracing()
+    assert final.exists() and not pathlib.Path(part).exists()
+
+
+def test_flight_recorder_respects_period(tmp_path):
+    from mapreduce_rust_tpu.runtime.trace import partial_path
+
+    tr = start_tracing()
+    part = partial_path(str(tmp_path / "t.json"))
+    tr.enable_flight_recorder(part, period_s=3600.0, min_new_events=10_000)
+    with trace_span("op"):
+        pass
+    # One event, an hour-long period: the tick is a cheap no-op.
+    assert tr.maybe_snapshot() is None
+    assert not pathlib.Path(part).exists()
+    stop_tracing()
+
+
 def test_disabled_tracing_is_inert_and_cheap():
     assert active_tracer() is None
     n = 20_000
