@@ -987,7 +987,9 @@ def main() -> None:
         result["zipf"] = zipf.get("zipf")
     if errors:
         result["error"] = "; ".join(errors)
+    result["doctor"] = _doctor_measured_leg(dev)
     _write_bench_manifest(result, dev, base_gbs)
+    _append_history(result)
     print(json.dumps(result))
     if dev:
         print(
@@ -995,6 +997,70 @@ def main() -> None:
                         "cpu_baseline_gbs": round(base_gbs, 4) if base_gbs else None}),
             file=sys.stderr,
         )
+
+
+def _doctor_measured_leg(dev) -> "dict | None":
+    """Run the doctor (analysis/doctor.py — backend-free, in-process) on
+    the measured leg's own run manifest, so every bench line names its
+    bottleneck and carries the ranked findings next to the number. The
+    run-manifest-on-disk describes the LAST completed leg (median repeats
+    rewrite it), which is the freshest leg of the same config — the
+    comment in _write_bench_manifest records the same caveat. Best-effort:
+    a doctor failure is itself a recorded fact, never a lost bench."""
+    path = (dev or {}).get("run_manifest")
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        from mapreduce_rust_tpu.analysis.doctor import diagnose
+        from mapreduce_rust_tpu.runtime.telemetry import load_manifest
+
+        diag = diagnose(load_manifest(path))
+        out = {
+            "bottleneck": (diag.get("bottleneck") or {}).get("name"),
+            "findings": [
+                f"[{f['severity']}] {f['code']}: {f['message']}"
+                for f in (diag.get("findings") or [])[:8]
+            ],
+            "manifest": path,
+        }
+        hists = diag.get("histograms_ms") or {}
+        for name in ("host_map.scan_s", "a2a.round_s", "device.drain_s"):
+            if name in hists:
+                out.setdefault("p99_ms", {})[name] = hists[name].get("p99")
+        print(f"doctor: bottleneck={out['bottleneck']} "
+              f"findings={len(out['findings'])}", file=sys.stderr)
+        return out
+    except Exception as e:
+        return {"error": repr(e)}
+
+
+def _append_history(result: dict) -> None:
+    """Append one line per bench run to .bench/history.jsonl — the memory
+    bench.py never had: `doctor --baseline` and a human diffing rounds get
+    a durable trajectory instead of whatever the last manifest overwrote.
+    One compact JSON object per line; errors recorded, never raised."""
+    try:
+        from mapreduce_rust_tpu.runtime.telemetry import git_rev
+
+        line = {
+            "created": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "git_rev": git_rev(),
+            "metric": result.get("metric"),
+            "value": result.get("value"),
+            "unit": result.get("unit"),
+            "vs_baseline": result.get("vs_baseline"),
+            "platform": result.get("platform"),
+            "doctor_bottleneck": (result.get("doctor") or {}).get("bottleneck"),
+            "zipf_gbs": (result.get("zipf") or {}).get("gbs"),
+            "had_errors": bool(result.get("error")),
+        }
+        BENCH_DIR.mkdir(exist_ok=True)
+        with open(BENCH_DIR / "history.jsonl", "a") as f:
+            f.write(json.dumps(line) + "\n")
+        print(f"history: appended to {BENCH_DIR / 'history.jsonl'}",
+              file=sys.stderr)
+    except Exception as e:
+        print(f"history append failed: {e!r}", file=sys.stderr)
 
 
 def _lint_counts() -> dict:

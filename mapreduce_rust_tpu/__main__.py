@@ -7,6 +7,7 @@ src/clean.sh), as subcommands of one module:
     python -m mapreduce_rust_tpu worker      # pull-based worker process
     python -m mapreduce_rust_tpu merge       # mr-*.txt → final.txt
     python -m mapreduce_rust_tpu clean       # rm intermediates/outputs
+    python -m mapreduce_rust_tpu doctor      # automated run diagnosis
 
 Unlike the reference — where the worker learns map_n/reduce_n from its own
 argv and a mismatch silently mis-shards the shuffle (SURVEY.md §3-E) — both
@@ -203,7 +204,11 @@ def cmd_merge(args) -> int:
 def cmd_stats(args) -> int:
     """Pretty-print a run manifest — or, with a second path, diff two
     (numeric fields with deltas): the BENCH round-over-round comparison
-    without scraping log tails."""
+    without scraping log tails. The diff also runs the doctor's
+    watched-metric regression gate (a -> b, a is the baseline): exit 3
+    when a watched metric regressed beyond its threshold, so CI can gate
+    on `stats old.json new.json`. --threshold-scale loosens/tightens every
+    threshold; --no-gate restores the unconditional exit 0."""
     from mapreduce_rust_tpu.runtime.telemetry import (
         diff_manifests,
         format_manifest,
@@ -222,7 +227,33 @@ def cmd_stats(args) -> int:
     print(f"diff {args.manifest} -> {args.other}:")
     for line in lines:
         print(line)
+    if getattr(args, "no_gate", False):
+        return 0
+    from mapreduce_rust_tpu.analysis.doctor import compare_manifests
+
+    regressions = compare_manifests(
+        a, b, threshold_scale=getattr(args, "threshold_scale", 1.0)
+    )
+    if regressions:
+        print(f"REGRESSIONS ({len(regressions)} watched metric(s)):")
+        for r in regressions:
+            chg = "new" if r["change"] is None else f"{r['change']:+.1%}"
+            print(
+                f"  {r['metric']}: {r['baseline']} -> {r['current']} "
+                f"[{chg}, threshold {r['threshold']:.0%} {r['direction']}]"
+            )
+        return 3
     return 0
+
+
+def cmd_doctor(args) -> int:
+    """Automated run diagnosis: bottleneck attribution, latency
+    percentiles, skew + straggler detection, lease advice, crash
+    forensics, and a --baseline regression gate. Backend-free, like every
+    analysis tool."""
+    from mapreduce_rust_tpu.analysis.doctor import run_cli
+
+    return run_cli(args)
 
 
 def cmd_trace(args) -> int:
@@ -413,7 +444,45 @@ def main(argv: list[str] | None = None) -> int:
     p = sub.add_parser("stats", help="pretty-print a run manifest, or diff two")
     p.add_argument("manifest", help="manifest.json of a run")
     p.add_argument("other", nargs="?", default=None,
-                   help="second manifest: print a field-level diff instead")
+                   help="second manifest: print a field-level diff and run "
+                   "the watched-metric regression gate (exit 3 on a "
+                   "regression; manifest = baseline, other = current)")
+    p.add_argument("--threshold-scale", type=float, default=1.0,
+                   dest="threshold_scale",
+                   help="multiply every watched-metric threshold "
+                   "(analysis/doctor.WATCHED_METRICS) by this factor; "
+                   "2.0 = twice as tolerant, 0.5 = twice as strict")
+    p.add_argument("--no-gate", action="store_true", dest="no_gate",
+                   help="diff only — always exit 0, as before the gate")
+    p.add_argument("-v", "--verbose", action="store_true")
+
+    p = sub.add_parser(
+        "doctor",
+        help="automated run diagnosis: bottleneck attribution, latency "
+        "percentiles, skew/straggler/lease findings, regression gate",
+    )
+    p.add_argument("manifest", help="run (or coordinator/bench) manifest to "
+                   "diagnose")
+    p.add_argument("--trace", default=None, metavar="TRACE",
+                   help="trace file (merged or per-process, partials "
+                   "accepted): enables attempt-chain crash forensics")
+    p.add_argument("--job-report", default=None, metavar="REPORT",
+                   dest="job_report",
+                   help="job_report.json (or a manifest embedding one): "
+                   "enables straggler/lease/re-execution analysis")
+    p.add_argument("--baseline", default=None, metavar="MANIFEST2",
+                   help="prior run's manifest: compare watched metrics and "
+                   "exit 1 when one regressed beyond threshold (CI gate)")
+    p.add_argument("--format", choices=["text", "json"], default="text",
+                   help="json: the full diagnosis document for CI diffs")
+    p.add_argument("--straggler-factor", type=float, default=2.0,
+                   dest="straggler_factor",
+                   help="flag workers whose task p50 exceeds this multiple "
+                   "of the fleet median (default 2.0)")
+    p.add_argument("--threshold-scale", type=float, default=1.0,
+                   dest="threshold_scale",
+                   help="scale every --baseline threshold (2.0 = twice as "
+                   "tolerant)")
     p.add_argument("-v", "--verbose", action="store_true")
 
     p = sub.add_parser(
@@ -458,6 +527,7 @@ def main(argv: list[str] | None = None) -> int:
         "merge": cmd_merge,
         "clean": cmd_clean,
         "stats": cmd_stats,
+        "doctor": cmd_doctor,
         "trace": cmd_trace,
         "watch": cmd_watch,
         "lint": cmd_lint,

@@ -1,0 +1,564 @@
+"""``doctor``: automated run diagnosis over the telemetry the framework
+already emits — the interpretation layer PRs 1/2/4 never had.
+
+A run manifest (and optionally its job report and trace) goes in; a ranked
+diagnosis comes out:
+
+- **Bottleneck attribution** — the same scan/stall/glue/device split the
+  manifest's ``bottleneck`` field encodes, extended with the two
+  components JobStats.bottleneck cannot see: interconnect time
+  (``all_to_all_s``) and XLA compile time. The primary name is computed
+  with JobStats' exact formula, so doctor and manifest always agree on the
+  legacy four; compile/ICI dominance is reported as a finding on top.
+- **Percentiles** — the histogram blocks (host-map windows, a2a rounds,
+  drains, RPC latencies, task attempts) rendered as p50/p95/p99/max.
+- **Skew** — reduce-partition output bytes and mesh shard fill counts
+  scored as max/mean; reduce-task duration imbalance from the job report.
+- **Stragglers** — per-worker attempt-duration histograms (the ``wid``
+  attribution satellite): a worker whose p50 exceeds the fleet median by
+  ``straggler_factor`` is flagged.
+- **Lease tuning** — observed task p99 vs the configured lease timeout.
+- **Crash forensics** — incomplete attempt chains (granted, never
+  finished) from the job report and from unterminated trace flow chains;
+  a crashed run's partial telemetry yields a diagnosis, never a stack
+  trace.
+- **Regression gate** — ``--baseline`` compares watched metrics against a
+  prior run's manifest with per-metric thresholds and exits non-zero, so
+  CI can gate on it. The same watched table backs ``stats <a> <b>``'s
+  new exit code.
+
+Pure stdlib, no jax (package rule: analysis tools run in any process in
+milliseconds).
+"""
+
+from __future__ import annotations
+
+import json
+
+from mapreduce_rust_tpu.runtime.histogram import Histogram
+# One flattener for both consumers of manifest paths: diff_manifests
+# (stats CLI) and the regression gate here must agree on metric naming.
+from mapreduce_rust_tpu.runtime.telemetry import _flatten
+
+DOCTOR_SCHEMA = 1
+
+_SEV_RANK = {"error": 0, "warn": 1, "info": 2}
+
+#: The regression gate's watched metrics: flattened manifest path →
+#: (direction, relative threshold). "up" = an increase beyond the
+#: threshold is a regression, "down" = a decrease is. Thresholds are
+#: deliberately loose (these gate CI on real, noisy timings); scale them
+#: with --threshold-scale.
+WATCHED_METRICS: dict = {
+    "stats.gb_per_s": ("down", 0.10),
+    "stats.wall_seconds": ("up", 0.25),
+    "stats.ingest_wait_s": ("up", 0.50),
+    "stats.device_wait_s": ("up", 0.50),
+    "stats.host_glue_s": ("up", 0.50),
+    "stats.scan_wait_s": ("up", 0.50),
+    "stats.all_to_all_s": ("up", 0.50),
+    "stats.compile.total_s": ("up", 1.00),
+    "stats.partial_overflow_replays": ("up", 0.00),
+    "stats.bucket_skew_replays": ("up", 0.00),
+    "stats.spilled_keys": ("up", 1.00),
+    "stats.histograms.host_map.scan_s.p95": ("up", 0.50),
+    "stats.histograms.host_map.glue_s.p95": ("up", 0.50),
+    "stats.histograms.a2a.round_s.p95": ("up", 0.50),
+    "stats.histograms.device.drain_s.p95": ("up", 0.50),
+}
+
+
+def compare_manifests(baseline: dict, current: dict,
+                      threshold_scale: float = 1.0) -> list[dict]:
+    """Watched-metric regressions of ``current`` vs ``baseline`` — the
+    ``--baseline`` CI gate's engine, shared with ``stats <a> <b>``.
+    Returns one entry per tripped metric; [] = no regression. A metric
+    absent from either side is skipped (older manifests predate the
+    histogram fields); zero baselines gate on any increase for count
+    metrics (threshold 0) and are skipped for ratio metrics."""
+    fb, fc = _flatten(baseline), _flatten(current)
+    regressions: list[dict] = []
+    for metric, (direction, rel) in sorted(WATCHED_METRICS.items()):
+        b, c = fb.get(metric), fc.get(metric)
+        if not isinstance(b, (int, float)) or not isinstance(c, (int, float)) \
+                or isinstance(b, bool) or isinstance(c, bool):
+            continue
+        threshold = rel * threshold_scale
+        if b == 0:
+            # No baseline signal to scale by: only the exact count metrics
+            # (threshold 0: "any increase regresses") stay armed.
+            if threshold == 0 and c > b:
+                delta = float("inf")
+            else:
+                continue
+        else:
+            change = (c - b) / abs(b)
+            worse = change > threshold if direction == "up" \
+                else change < -threshold
+            if not worse:
+                continue
+            delta = change
+        regressions.append({
+            "metric": metric,
+            "baseline": b,
+            "current": c,
+            "change": None if delta == float("inf") else round(delta, 4),
+            "direction": direction,
+            "threshold": threshold,
+        })
+    return regressions
+
+
+# ---------------------------------------------------------------------------
+# Diagnosis
+# ---------------------------------------------------------------------------
+
+def _hist(d: "dict | None") -> "Histogram | None":
+    if not d or not d.get("count"):
+        return None
+    return Histogram.from_dict(d)
+
+
+def _skew_score(values: "list | None") -> "dict | None":
+    vals = [v for v in (values or []) if isinstance(v, (int, float))]
+    if len(vals) < 2 or sum(vals) <= 0:
+        return None
+    mean = sum(vals) / len(vals)
+    return {
+        "n": len(vals),
+        "max": max(vals),
+        "mean": round(mean, 3),
+        # 1.0 = perfectly balanced; 2.0 = the hottest slot carries twice
+        # its fair share.
+        "score": round(max(vals) / mean, 3) if mean else None,
+    }
+
+
+def _bottleneck_attribution(stats: dict) -> dict:
+    """JobStats.bottleneck's exact formula over the manifest's stats dict,
+    extended with the ICI and compile components it cannot express."""
+    workers = stats.get("host_map_workers") or 0
+    scan = stats.get("host_map_s", 0.0) if workers <= 1 \
+        else stats.get("scan_wait_s", 0.0)
+    legacy = {
+        "host-ingest": stats.get("ingest_wait_s", 0.0) or 0.0,
+        "device": stats.get("device_wait_s", 0.0) or 0.0,
+        "host-map": scan or 0.0,
+        "host-glue": stats.get("host_glue_s", 0.0) or 0.0,
+    }
+    name, val = max(legacy.items(), key=lambda kv: kv[1])
+    primary = name if val > 0 else "balanced"
+    extended = dict(legacy)
+    extended["ici"] = stats.get("all_to_all_s", 0.0) or 0.0
+    extended["compile"] = (stats.get("compile") or {}).get("total_s", 0.0)
+    total = sum(extended.values())
+    ranked = [
+        {
+            "component": comp,
+            "seconds": round(secs, 6),
+            "share": round(secs / total, 4) if total else None,
+        }
+        for comp, secs in sorted(
+            extended.items(), key=lambda kv: kv[1], reverse=True
+        )
+    ]
+    return {
+        "name": primary,
+        "recorded": stats.get("bottleneck"),
+        "agrees_with_stats": (
+            stats.get("bottleneck") is None or primary == stats.get("bottleneck")
+        ),
+        "attribution": ranked,
+    }
+
+
+def _flow_chains(events: list) -> dict:
+    chains: dict = {}
+    for e in events:
+        if e.get("ph") in ("s", "t", "f"):
+            chains.setdefault(e.get("id"), set()).add(e["ph"])
+    return chains
+
+
+def _load_trace_events(path: str) -> list:
+    from mapreduce_rust_tpu.runtime.trace import load_trace
+
+    events, _md = load_trace(path)
+    return events
+
+
+def diagnose(manifest: dict, job_report: "dict | None" = None,
+             trace_events: "list | None" = None,
+             straggler_factor: float = 2.0) -> dict:
+    """The diagnosis pass. ``manifest`` is a loaded run/coordinator/bench
+    manifest (stats optional — a control-plane manifest diagnoses from its
+    embedded job report); ``job_report`` overrides/augments the manifest's
+    embedded report; ``trace_events`` enables flow-chain forensics.
+    Total-function by design: partial telemetry from a crashed run yields
+    a partial diagnosis plus findings, never an exception."""
+    findings: list[dict] = []
+
+    def find(severity: str, code: str, message: str) -> None:
+        findings.append({"severity": severity, "code": code, "message": message})
+
+    stats = manifest.get("stats") or {}
+    report = job_report if job_report is not None \
+        else manifest.get("job_report") or manifest.get("report")
+    diag: dict = {"schema": DOCTOR_SCHEMA, "kind": manifest.get("kind")}
+
+    if manifest.get("error"):
+        find("error", "run-error",
+             f"run recorded an error: {manifest['error']} — diagnosis is of "
+             "the partial telemetry a crashed run left behind")
+
+    # ---- bottleneck ----
+    if stats:
+        bn = _bottleneck_attribution(stats)
+        diag["bottleneck"] = bn
+        if not bn["agrees_with_stats"]:
+            find("warn", "bottleneck-mismatch",
+                 f"doctor attributes the run to {bn['name']!r} but the "
+                 f"manifest recorded {bn['recorded']!r} — the manifest was "
+                 "written by a different stats formula; trust the raw parts")
+        top = bn["attribution"][0] if bn["attribution"] else None
+        if top and top["component"] in ("ici", "compile") and top["seconds"] > 0:
+            find("warn", f"{top['component']}-bound",
+                 f"{top['component']} time ({top['seconds']:.3f}s) exceeds "
+                 f"every host/device wait component — the legacy bottleneck "
+                 f"field ({bn['name']!r}) cannot express this; "
+                 + ("a persistent compilation cache or longer run amortizes it"
+                    if top["component"] == "compile"
+                    else "fewer/fatter all_to_all rounds would"))
+        wall = stats.get("wall_seconds") or 0.0
+        comp = stats.get("compile") or {}
+        if comp and wall and comp.get("total_s", 0.0) > 0.5 * wall:
+            find("warn", "compile-dominates",
+                 f"XLA compiles took {comp['total_s']:.2f}s of a "
+                 f"{wall:.2f}s run ({comp.get('cache_hits', 0)} cache hits, "
+                 f"{comp.get('cache_misses', 0)} misses) — warm the "
+                 "persistent cache or measure a longer run")
+
+    # ---- percentiles ----
+    hists = {
+        name: h.summary(scale=1e3, digits=3)  # seconds → ms
+        for name, hd in sorted((stats.get("histograms") or {}).items())
+        if name.endswith("_s") and (h := _hist(hd)) is not None
+    }
+    for name, hd in sorted((stats.get("histograms") or {}).items()):
+        if not name.endswith("_s") and (h := _hist(hd)) is not None:
+            hists[name] = h.summary(scale=1.0, digits=1)
+    if hists:
+        diag["histograms_ms"] = hists
+    if report and report.get("rpc"):
+        diag["rpc_ms"] = {
+            m: {k: r.get(k) for k in
+                ("count", "p50_ms", "p95_ms", "p99_ms", "max_ms")}
+            for m, r in sorted(report["rpc"].items())
+        }
+
+    # ---- skew ----
+    skew = {}
+    parts = _skew_score(stats.get("partition_bytes"))
+    if parts is not None:
+        skew["reduce_partition_bytes"] = parts
+        if parts["score"] and parts["score"] > 2.0 and parts["n"] >= 4:
+            find("warn", "reduce-skew",
+                 f"hottest reduce partition holds {parts['score']:.1f}x its "
+                 f"fair share of output bytes ({parts['max']} of mean "
+                 f"{parts['mean']:.0f}) — keys hash-route unevenly; raise "
+                 "reduce_n or revisit the partition key")
+    shards = _skew_score(stats.get("mesh_shard_rows"))
+    if shards is not None:
+        skew["mesh_shard_rows"] = shards
+        if shards["score"] and shards["score"] > 2.0:
+            find("warn", "shard-skew",
+                 f"hottest mesh shard holds {shards['score']:.1f}x the mean "
+                 "distinct-key load — one chip's merge/egress carries the "
+                 "job (hash-class imbalance)")
+    if report:
+        durs = [
+            t.get("duration_s")
+            for t in (report.get("tasks") or {}).get("reduce", {}).values()
+            if t.get("duration_s")
+        ]
+        rd = _skew_score(durs)
+        if rd is not None:
+            skew["reduce_task_duration_s"] = rd
+            if rd["score"] and rd["score"] > 2.0 and rd["n"] >= 3:
+                find("warn", "reduce-duration-skew",
+                     f"slowest reduce task ran {rd['score']:.1f}x the mean "
+                     "duration — partition skew or a straggling worker")
+    if skew:
+        diag["skew"] = skew
+
+    # ---- stragglers ----
+    if report and report.get("workers"):
+        per_worker = {}
+        p50s = {}
+        for wid, w in report["workers"].items():
+            h = _hist(w.get("task_s"))
+            per_worker[wid] = {
+                "reports": w.get("reports", 0),
+                "grants": w.get("grants", 0),
+                "task_p50_s": h.percentile(0.5) if h else None,
+                "task_p99_s": h.percentile(0.99) if h else None,
+            }
+            if h is not None:
+                p50s[wid] = h.percentile(0.5)
+        flagged = []
+        if len(p50s) >= 2:
+            # LOWER median: with two workers the reference must be the
+            # faster one, or the slow worker would be its own yardstick
+            # and a 2-fleet straggler could never be flagged.
+            med = sorted(p50s.values())[(len(p50s) - 1) // 2]
+            if med > 0:
+                flagged = sorted(
+                    wid for wid, p in p50s.items()
+                    if p > straggler_factor * med
+                )
+        diag["stragglers"] = {
+            "factor": straggler_factor,
+            "workers": per_worker,
+            "flagged": flagged,
+        }
+        for wid in flagged:
+            find("warn", "straggler",
+                 f"worker {wid}: task p50 {p50s[wid]:.3f}s exceeds "
+                 f"{straggler_factor:.1f}x the fleet median — a slow host, "
+                 "an oversubscribed core, or skewed inputs")
+
+    # ---- lease tuning ----
+    lease_s = (manifest.get("config") or {}).get("lease_timeout_s")
+    if report and lease_s:
+        p99s = [
+            h.percentile(0.99)
+            for tot in (report.get("totals") or {}).values()
+            if (h := _hist(tot.get("task_s"))) is not None
+        ]
+        expiries = sum(
+            tot.get("expiries", 0)
+            for tot in (report.get("totals") or {}).values()
+        )
+        if p99s:
+            p99 = max(p99s)
+            advice = None
+            if p99 >= 0.8 * lease_s:
+                advice = (
+                    f"task p99 ({p99:.2f}s) crowds the {lease_s:.1f}s lease "
+                    "timeout — healthy tasks risk expiry; raise "
+                    "--lease-timeout or shrink tasks"
+                )
+                find("warn" if expiries else "info", "lease-tight", advice)
+            elif lease_s > 20 * p99:
+                advice = (
+                    f"lease timeout ({lease_s:.1f}s) is {lease_s / p99:.0f}x "
+                    f"the task p99 ({p99:.2f}s) — a dead worker blocks its "
+                    "task that long; a lower --lease-timeout recovers faster"
+                )
+                find("info", "lease-loose", advice)
+            diag["lease"] = {
+                "timeout_s": lease_s,
+                "task_p99_s": round(p99, 4),
+                "expiries": expiries,
+                "advice": advice,
+            }
+
+    # ---- compile / device memory ----
+    comp = stats.get("compile")
+    if comp:
+        diag["compile"] = comp
+    if stats.get("device_mem_high_bytes"):
+        diag["device_memory"] = {
+            "high_water_bytes": stats["device_mem_high_bytes"]
+        }
+
+    # ---- crash forensics: incomplete attempt chains ----
+    incomplete_tasks = []
+    if report:
+        for phase, tasks in (report.get("tasks") or {}).items():
+            for tid, t in tasks.items():
+                if t.get("grants", 0) > 0 and not t.get("completed"):
+                    incomplete_tasks.append(f"{phase}:{tid}")
+        expiries = sum(
+            tot.get("expiries", 0)
+            for tot in (report.get("totals") or {}).values()
+        )
+        reexecs = sum(
+            tot.get("re_executions", 0)
+            for tot in (report.get("totals") or {}).values()
+        )
+        if expiries or reexecs:
+            find("info", "re-execution",
+                 f"{expiries} lease expirie(s), {reexecs} re-execution(s) — "
+                 "a worker died or stalled mid-task; the timeline's forked "
+                 "attempt chains name which")
+    incomplete_flows = []
+    if trace_events:
+        chains = _flow_chains(trace_events)
+        incomplete_flows = sorted(
+            fid for fid, phs in chains.items() if fid and "f" not in phs
+        )
+    if incomplete_tasks or incomplete_flows:
+        diag["incomplete"] = {
+            "tasks": sorted(incomplete_tasks),
+            "flows": incomplete_flows,
+        }
+        for label, items in (("task", incomplete_tasks),
+                             ("attempt chain", incomplete_flows)):
+            if items:
+                find("error" if label == "task" else "warn",
+                     "incomplete-" + ("task" if label == "task" else "chain"),
+                     f"{len(items)} {label}(s) started but never finished "
+                     f"({', '.join(items[:6])}"
+                     + (", …" if len(items) > 6 else "") + ") — a crashed "
+                     "or SIGKILLed attempt; the flight-recorder partial "
+                     "holds its last events")
+
+    if not stats and not report:
+        find("error", "no-telemetry",
+             "manifest carries neither stats nor a job report — nothing to "
+             "diagnose (was this a bench-harness or sweep manifest?)")
+
+    findings.sort(key=lambda f: _SEV_RANK.get(f["severity"], 9))
+    diag["findings"] = findings
+    return diag
+
+
+# ---------------------------------------------------------------------------
+# Rendering + CLI
+# ---------------------------------------------------------------------------
+
+def format_diagnosis(diag: dict, regressions: "list | None" = None) -> str:
+    lines = [f"doctor diagnosis (schema {diag.get('schema')})"]
+    bn = diag.get("bottleneck")
+    if bn:
+        agree = "" if bn.get("agrees_with_stats") else \
+            f"  [manifest recorded {bn.get('recorded')!r}]"
+        lines.append(f"  bottleneck: {bn['name']}{agree}")
+        for a in bn.get("attribution") or []:
+            share = f" ({a['share']:.0%})" if a.get("share") is not None else ""
+            lines.append(
+                f"    {a['component']:<12} {a['seconds']:9.3f}s{share}"
+            )
+    for name, h in sorted((diag.get("histograms_ms") or {}).items()):
+        if not h.get("count"):
+            continue
+        unit = "ms" if name.endswith("_s") else ""
+        lines.append(
+            f"  hist {name:<20} n={h['count']:<6} p50={h['p50']:g} "
+            f"p95={h['p95']:g} p99={h['p99']:g} max={h['max']:g} {unit}"
+        )
+    for m, r in sorted((diag.get("rpc_ms") or {}).items()):
+        lines.append(
+            f"  rpc  {m:<24} n={r.get('count', 0):<6} "
+            f"p50={r.get('p50_ms', 0)}ms p99={r.get('p99_ms', 0)}ms "
+            f"max={r.get('max_ms', 0)}ms"
+        )
+    for key, s in sorted((diag.get("skew") or {}).items()):
+        lines.append(
+            f"  skew {key}: score {s.get('score')} "
+            f"(max {s.get('max')} / mean {s.get('mean')}, n={s.get('n')})"
+        )
+    st = diag.get("stragglers")
+    if st:
+        flagged = st.get("flagged") or []
+        lines.append(
+            f"  stragglers: {len(flagged)} flagged of "
+            f"{len(st.get('workers') or {})} workers "
+            f"(factor {st.get('factor')})"
+            + (f" — {', '.join('w' + str(w) for w in flagged)}" if flagged else "")
+        )
+    lease = diag.get("lease")
+    if lease:
+        lines.append(
+            f"  lease: timeout {lease['timeout_s']}s vs task p99 "
+            f"{lease['task_p99_s']}s ({lease.get('expiries', 0)} expiries)"
+        )
+    comp = diag.get("compile")
+    if comp:
+        lines.append(
+            f"  compile: {comp.get('count')} compiles {comp.get('total_s')}s "
+            f"({comp.get('cache_hits')} hits, {comp.get('cache_misses')} "
+            "misses)"
+        )
+    mem = diag.get("device_memory")
+    if mem:
+        lines.append(
+            f"  device memory high-water: "
+            f"{mem['high_water_bytes'] / 1e6:.1f} MB"
+        )
+    inc = diag.get("incomplete")
+    if inc:
+        lines.append(
+            f"  incomplete: tasks={inc.get('tasks')} flows={inc.get('flows')}"
+        )
+    for f in diag.get("findings") or []:
+        lines.append(f"  [{f['severity'].upper():<5}] {f['code']}: {f['message']}")
+    if not (diag.get("findings") or []):
+        lines.append("  no findings — run looks healthy")
+    if regressions is not None:
+        if regressions:
+            lines.append(f"  REGRESSIONS vs baseline ({len(regressions)}):")
+            for r in regressions:
+                chg = "new" if r["change"] is None else f"{r['change']:+.1%}"
+                lines.append(
+                    f"    {r['metric']}: {r['baseline']} -> {r['current']} "
+                    f"[{chg}, threshold {r['threshold']:.0%} {r['direction']}]"
+                )
+        else:
+            lines.append("  baseline: no watched metric regressed")
+    return "\n".join(lines)
+
+
+def run_cli(args) -> int:
+    """``doctor`` subcommand body. Exit 0 = diagnosis produced; 1 = a
+    --baseline watched metric regressed (the CI gate); 2 = unreadable
+    input."""
+    from mapreduce_rust_tpu.runtime.telemetry import load_manifest
+
+    try:
+        manifest = load_manifest(args.manifest)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"doctor: cannot read manifest {args.manifest!r}: {e}")
+        return 2
+
+    job_report = None
+    if getattr(args, "job_report", None):
+        try:
+            doc = load_manifest(args.job_report)
+            job_report = doc.get("report", doc)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"doctor: cannot read job report {args.job_report!r}: {e}")
+            return 2
+
+    trace_events = None
+    if getattr(args, "trace", None):
+        try:
+            trace_events = _load_trace_events(args.trace)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"doctor: cannot read trace {args.trace!r}: {e}")
+            return 2
+
+    diag = diagnose(
+        manifest, job_report=job_report, trace_events=trace_events,
+        straggler_factor=getattr(args, "straggler_factor", 2.0),
+    )
+
+    regressions = None
+    if getattr(args, "baseline", None):
+        try:
+            base = load_manifest(args.baseline)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"doctor: cannot read baseline {args.baseline!r}: {e}")
+            return 2
+        regressions = compare_manifests(
+            base, manifest,
+            threshold_scale=getattr(args, "threshold_scale", 1.0),
+        )
+        diag["regressions"] = regressions
+
+    if getattr(args, "format", "text") == "json":
+        print(json.dumps(diag, indent=2, sort_keys=True))
+    else:
+        print(format_diagnosis(diag, regressions))
+    return 1 if regressions else 0

@@ -586,6 +586,102 @@ class JitInLoopRule(Rule):
                 )
 
 
+class PsumReplicatedFlagRule(Rule):
+    """No ``psum`` of a value that is already psum-replicated.
+
+    The multi-process drivers depend on replicated decision flags: the
+    shuffle step fns psum their overflow counters exactly once
+    (``_chip_shuffle_tail``), after which every chip holds the identical
+    global total and any process reads ONE local shard
+    (``make_mh_shuffle_step_fns`` contract, parallel/shuffle.py). Psumming
+    such a value again multiplies it by the axis size — a replay flag that
+    should read 1 reads D, and on a flag compared ``== 0`` the bug is
+    silent until a skewed input makes every process disagree about a
+    replay. Encodes the PR 3 ROADMAP leftover ("psum-replicated-flag
+    misuse in multi-process drivers") as a rule instead of a review note.
+
+    Precision: fires only on (a) a ``psum`` call whose argument subtree
+    contains another ``psum`` call, and (b) ``psum(x, ...)`` where ``x``
+    was assigned from a ``psum`` call in the same function scope. A single
+    psum of per-chip values — the shipped pattern — never matches.
+    """
+
+    name = "psum-replicated-flag"
+    summary = "no psum of an already-psum-replicated value (multiplies by D)"
+
+    def _is_psum(self, node) -> bool:
+        return isinstance(node, ast.Call) and \
+            _last_segment(qualname(node.func)) == "psum"
+
+    def run(self, tree, src, path):
+        scopes = [tree] + [
+            n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for scope in scopes:
+            yield from self._scan_scope(scope, path)
+
+    def _own_nodes(self, scope):
+        body = scope.body if isinstance(
+            scope, (ast.Module, ast.FunctionDef, ast.AsyncFunctionDef)
+        ) else [scope]
+        stack = list(body)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # nested scope gets its own pass
+            yield n
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _scan_scope(self, scope, path):
+        # name → line numbers where it was ASSIGNED from a psum call. The
+        # match below requires a strictly earlier definition line, so the
+        # common rebinding idiom `x = psum(x, AXIS)` — a single psum whose
+        # argument is the pre-assignment (per-chip) value — never fires.
+        def_lines: dict[str, list[int]] = {}
+        for n in self._own_nodes(scope):
+            if isinstance(n, ast.Assign) and self._is_psum(n.value):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        def_lines.setdefault(t.id, []).append(n.lineno)
+            elif isinstance(n, ast.AnnAssign) and n.value is not None \
+                    and self._is_psum(n.value) and isinstance(n.target, ast.Name):
+                def_lines.setdefault(n.target.id, []).append(n.lineno)
+        for n in self._own_nodes(scope):
+            if not self._is_psum(n):
+                continue
+            inner = next(
+                (s for a in n.args for s in ast.walk(a) if self._is_psum(s)),
+                None,
+            )
+            if inner is not None:
+                yield self.finding(
+                    path, n,
+                    "psum of a psum result multiplies the total by the axis "
+                    "size — the inner psum already replicated it to every "
+                    "chip; read one shard instead",
+                )
+                continue
+            for a in n.args:
+                for s in ast.walk(a):
+                    if isinstance(s, ast.Name) and any(
+                        line < n.lineno for line in def_lines.get(s.id, ())
+                    ):
+                        yield self.finding(
+                            path, n,
+                            f"{s.id!r} is already a psum-replicated value — "
+                            "psumming it again multiplies the flag by the "
+                            "axis size (a replay flag compared == 0 then "
+                            "lies); psum the per-chip value exactly once "
+                            "and read one shard (make_mh_shuffle_step_fns "
+                            "contract)",
+                        )
+                        break
+                else:
+                    continue
+                break
+
+
 ALL_RULES: list[Rule] = [
     StatsOwnershipRule(),
     ExecutorTeardownRule(),
@@ -595,4 +691,5 @@ ALL_RULES: list[Rule] = [
     SpanBalanceRule(),
     SpilledDictApiRule(),
     JitInLoopRule(),
+    PsumReplicatedFlagRule(),
 ]

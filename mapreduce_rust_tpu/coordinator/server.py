@@ -273,10 +273,10 @@ class Coordinator:
         log.info("worker %d registered (%d/%d)", wid, self.worker_count, self.cfg.worker_n)
         return wid
 
-    def _grant(self, phase: "_Phase", name: str) -> int:
+    def _grant(self, phase: "_Phase", name: str, wid: int = -1) -> int:
         tid = phase.grant()
         if tid >= 0:
-            self.report.record_grant(name, tid)
+            self.report.record_grant(name, tid, wid=wid)
             # Flow chain start: the grant span forks an arrow the worker's
             # task span steps and the finish-report RPC terminates. The
             # attempt suffix makes a re-execution a SECOND chain.
@@ -287,34 +287,42 @@ class Coordinator:
             )
         return tid
 
-    def get_map_task(self) -> int:
+    # ``wid`` on the task RPCs (ISSUE 5 satellite, the PR 4 ROADMAP
+    # leftover): grants/renewals/finishes attribute per WORKER as well as
+    # per task, so `watch` shows a per-worker column and the doctor's
+    # straggler pass can compare workers. Trailing-with-default keeps the
+    # wire format compatible with pre-wid clients (params [tid] still
+    # parse) and with every in-process test caller.
+
+    def get_map_task(self, wid: int = -1) -> int:
         if not self.prepare():
             return NOT_READY  # registration barrier (coordinator.rs:142-144)
-        return self._grant(self.map, "map")
+        return self._grant(self.map, "map", wid)
 
-    def get_reduce_task(self) -> int:
+    def get_reduce_task(self, wid: int = -1) -> int:
         if not self.map.finished:
             return NOT_READY  # phase gate (coordinator.rs:183-185)
-        return self._grant(self.reduce, "reduce")
+        return self._grant(self.reduce, "reduce", wid)
 
-    def renew_map_lease(self, tid: int) -> bool:
+    def renew_map_lease(self, tid: int, wid: int = -1) -> bool:
         ok = self.map.renew(tid)
-        self.report.record_renewal("map", tid, ok)
+        self.report.record_renewal("map", tid, ok, wid=wid)
         return ok
 
-    def renew_reduce_lease(self, tid: int) -> bool:
+    def renew_reduce_lease(self, tid: int, wid: int = -1) -> bool:
         ok = self.reduce.renew(tid)
-        self.report.record_renewal("reduce", tid, ok)
+        self.report.record_renewal("reduce", tid, ok, wid=wid)
         return ok
 
-    def _finish(self, phase: "_Phase", name: str, tid: int, attempt: int) -> bool:
+    def _finish(self, phase: "_Phase", name: str, tid: int, attempt: int,
+                wid: int = -1) -> bool:
         # Idempotent per (phase, tid): the duplicate completion of a
         # re-executed task (original + replacement both report) used to
         # double-journal and double-count — now it lands as a distinct
         # late_reports stat and journals exactly once (ISSUE 4 satellite).
         first = tid not in phase.reported
         done = phase.report_finish(tid)
-        self.report.record_finish(name, tid, late=not first)
+        self.report.record_finish(name, tid, late=not first, wid=wid)
         fid = f"{name}:{tid}:{attempt or self.report.attempts(name, tid)}"
         if fid not in self._flow_finished:
             # Guard the flow chain's single-finish invariant even if two
@@ -326,13 +334,15 @@ class Coordinator:
             self._journal(name, tid)
         return done
 
-    def report_map_task_finish(self, tid: int, attempt: int = 0) -> bool:
-        done = self._finish(self.map, "map", tid, attempt)
+    def report_map_task_finish(self, tid: int, attempt: int = 0,
+                               wid: int = -1) -> bool:
+        done = self._finish(self.map, "map", tid, attempt, wid)
         log.info("map %d finished (phase done=%s)", tid, done)
         return done
 
-    def report_reduce_task_finish(self, tid: int, attempt: int = 0) -> bool:
-        done = self._finish(self.reduce, "reduce", tid, attempt)
+    def report_reduce_task_finish(self, tid: int, attempt: int = 0,
+                                  wid: int = -1) -> bool:
+        done = self._finish(self.reduce, "reduce", tid, attempt, wid)
         log.info("reduce %d finished (job done=%s)", tid, done)
         return done
 
@@ -384,6 +394,10 @@ class Coordinator:
             "workers": {
                 "registered": self.worker_count,
                 "expected": self.cfg.worker_n,
+                # Per-worker detail lives ONCE in the response: the stats
+                # RPC's top-level "workers" block (JobReport.to_dict) —
+                # what `watch` renders as the worker column. Duplicating
+                # it here would recompute every percentile per poll tick.
             },
             "uptime_s": round(self.report.uptime_s(), 3),
             "phases": phases,

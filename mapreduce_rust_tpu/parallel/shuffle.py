@@ -341,3 +341,16 @@ def local_rows(x) -> np.ndarray:
 def local_batch(batch: KVBatch) -> KVBatch:
     """local_rows over every leaf of a sharded KVBatch."""
     return KVBatch(*(local_rows(x) for x in batch))
+
+
+def shard_fill_counts(state: KVBatch) -> "list[int]":
+    """Valid-record count per ADDRESSABLE shard of a [D, cap]-sharded
+    state, in global shard order — the hash-class skew signal: each chip's
+    shard holds exactly its hash classes' distinct keys, so a hot shard
+    here means the key distribution (not the interconnect) is what one
+    chip's merge and egress are paying for. One blocking readback of D
+    bool vectors; call at finalize, never from the stream loop."""
+    shards = sorted(
+        state.valid.addressable_shards, key=lambda s: s.index[0].start or 0
+    )
+    return [int(np.asarray(s.data).sum()) for s in shards]

@@ -75,6 +75,7 @@ from mapreduce_rust_tpu.runtime.dictionary import (
 )
 from mapreduce_rust_tpu.runtime.metrics import JobStats, log
 from mapreduce_rust_tpu.runtime.trace import (
+    active_tracer,
     maybe_snapshot,
     partial_path,
     start_tracing,
@@ -84,6 +85,94 @@ from mapreduce_rust_tpu.runtime.trace import (
 )
 
 _cc_enabled = False
+
+
+# ---------------------------------------------------------------------------
+# XLA compile instrumentation (ISSUE 5 tentpole: the trace layer never saw
+# device-side compiles — a cold run's dominant cost was invisible)
+# ---------------------------------------------------------------------------
+
+#: Every backend compile jax reported via its monitoring events since the
+#: listener was installed: {"dur_s", "cache": "hit"|"miss"|"uncached"}.
+#: run_job slices [n0:] around its own interval, so the log never needs
+#: clearing (concurrent run_jobs in one process are already unsupported —
+#: same contract as the tracer).
+_COMPILE_LOG: list[dict] = []
+_COMPILE_TRACK_TID = -2  # synthetic trace track: compile intervals are
+# measured by jax's wall clock, not ours — on their own track they can
+# never partially overlap this thread's call-structured spans
+_compile_listener_installed = False
+_compile_cache_state: list[str] = []  # hit/miss events awaiting their compile
+
+
+def _install_compile_listener() -> None:
+    """Idempotently hook jax.monitoring: one record (and one ``xla.compile``
+    trace span, when tracing) per backend compile, with persistent-cache
+    hit/miss status. Listener registration is append-only in jax, hence the
+    once-per-process guard."""
+    global _compile_listener_installed
+    if _compile_listener_installed:
+        return
+    _compile_listener_installed = True
+    import jax.monitoring as monitoring
+
+    def on_event(event: str, **_kw) -> None:
+        # Cache events fire inside compile_or_get_cached, strictly before
+        # the duration event that closes the same compile: a hit on the
+        # read path, a miss when the fresh result is written back. A
+        # compile with neither (cache disabled, or entry below the
+        # min-compile-time/min-size write thresholds) is "uncached".
+        if event.endswith("/compilation_cache/cache_hits"):
+            _compile_cache_state.append("hit")
+        elif event.endswith("/compilation_cache/cache_misses"):
+            _compile_cache_state.append("miss")
+
+    def on_duration(event: str, duration: float, **_kw) -> None:
+        if event != "/jax/core/compile/backend_compile_duration":
+            return
+        cache = _compile_cache_state.pop() if _compile_cache_state else "uncached"
+        _compile_cache_state.clear()  # never let a stale event cross compiles
+        _COMPILE_LOG.append({"dur_s": duration, "cache": cache})
+        tr = active_tracer()
+        if tr is not None:
+            t1 = time.perf_counter()
+            tr.add_span(
+                "xla.compile", t1 - duration, t1,
+                {"cache": cache, "seconds": round(duration, 3)},
+                tid=_COMPILE_TRACK_TID,
+            )
+
+    monitoring.register_event_listener(on_event)
+    monitoring.register_event_duration_secs_listener(on_duration)
+
+
+_MEM_SAMPLE_PERIOD_S = 0.5
+_mem_last_sample = [0.0]
+
+
+def _sample_device_memory(stats) -> None:
+    """Device-memory gauge, fed from the existing drain/consume loops
+    (never per record): Chrome "C" counter samples per local device when
+    tracing, plus a manifest high-water mark. Backends without
+    ``memory_stats`` (CPU) simply contribute nothing. Throttled so a
+    fast drain loop doesn't turn the gauge into the hot path."""
+    now = time.monotonic()
+    if now - _mem_last_sample[0] < _MEM_SAMPLE_PERIOD_S:
+        return
+    _mem_last_sample[0] = now
+    try:
+        for i, dev in enumerate(jax.local_devices()):
+            ms = dev.memory_stats()
+            if not ms:
+                continue
+            in_use = ms.get("bytes_in_use")
+            if in_use is None:
+                continue
+            trace_counter(f"device.mem.d{i}", bytes_in_use=int(in_use))
+            if in_use > stats.device_mem_high_bytes:
+                stats.device_mem_high_bytes = int(in_use)
+    except Exception:  # a telemetry probe must never fail the run
+        pass
 
 
 def enable_compilation_cache(path: str | None = "auto") -> None:
@@ -469,7 +558,14 @@ def _a2a_span(stats, **span_args):
         with trace_span("mesh.all_to_all", **span_args):
             yield
     finally:
-        stats.all_to_all_s += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        stats.all_to_all_s += dt
+        # Per-round distribution beside the aggregate: the manifest then
+        # carries a2a p50/p95/p99 even for untraced runs (ISSUE 5).
+        stats.record_hist("a2a.round_s", dt)
+        wb = span_args.get("wire_bytes")
+        if wb:
+            stats.record_hist("a2a.wire_bytes", wb)
 
 
 class _IngestStream:
@@ -553,7 +649,9 @@ class _IngestStream:
             t0 = time.perf_counter()
             with trace_span("ingest.wait"):
                 chunk = self.q.get()
-            self.stats.ingest_wait_s += time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            self.stats.ingest_wait_s += dt
+            self.stats.record_hist("ingest.wait_s", dt)
             if chunk is _SENTINEL:
                 if self.err is not None:
                     raise self.err
@@ -635,7 +733,10 @@ def _stream_single(cfg: Config, app: App, inputs, stats, acc, dictionary,
         t0 = time.perf_counter()
         with trace_span("device.drain", steps=n):
             flat = jax.device_get([x for (ovf, evc, *_rest) in batch for x in (ovf, evc)])
-        stats.device_wait_s += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        stats.device_wait_s += dt
+        stats.record_hist("device.drain_s", dt)
+        _sample_device_memory(stats)
         for (ovf, evc, evicted, chunk_host, did), ovf_n, ev_n in zip(
             batch, flat[::2], flat[1::2]
         ):
@@ -828,7 +929,10 @@ def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
         t0 = time.perf_counter()
         with trace_span("device.drain", steps=n):
             counts = jax.device_get([ev for ev, _ in batch])
-        stats.device_wait_s += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        stats.device_wait_s += dt
+        stats.record_hist("device.drain_s", dt)
+        _sample_device_memory(stats)
         for (ev, evicted), ev_n in zip(batch, counts):
             if int(ev_n) > 0:
                 stats.spill_events += 1
@@ -855,6 +959,9 @@ def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
         nonlocal state
         doc_id, kind, res, scan_s = result
         stats.host_map_s += scan_s  # aggregate scan seconds across workers
+        # Per-window scan distribution: a high-cardinality window shows up
+        # as a p99 tail here long before it moves the aggregate (ISSUE 5).
+        stats.record_hist("host_map.scan_s", scan_s)
         t_glue = time.perf_counter()
         with trace_span("host_glue"):
             stats.chunks += 1
@@ -883,7 +990,9 @@ def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
                 pending.append((ev_count, evicted))
         # Glue stops before drain: drain's blocking readback is already
         # accounted in device_wait_s and must not be double-counted.
-        stats.host_glue_s += time.perf_counter() - t_glue
+        glue_dt = time.perf_counter() - t_glue
+        stats.host_glue_s += glue_dt
+        stats.record_hist("host_map.glue_s", glue_dt)
         maybe_snapshot()  # flight-recorder tick: per window, consumer thread
         if len(pending) >= 2 * depth:
             drain(depth)
@@ -903,7 +1012,9 @@ def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
         t0 = time.perf_counter()
         with trace_span("host_map.stall"):
             res = fut.result()
-        stats.scan_wait_s += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        stats.scan_wait_s += dt
+        stats.record_hist("host_map.stall_s", dt)
         trace_counter("host_map.inflight", scans=len(inflight),
                       merges=len(pending))
         return res
@@ -1108,7 +1219,9 @@ def _stream_multihost(cfg: Config, app: App, inputs, stats, acc, dictionary) -> 
                 [x.addressable_shards[0].data for x in (bad_p, bad_b, flags)]
                 + [s.data for s in ev_counts.addressable_shards]
             )
-        stats.device_wait_s += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        stats.device_wait_s += dt
+        stats.record_hist("device.drain_s", dt)
         bad_p_l, bad_b_l, flags_l = got[:3]
         ev_local = np.concatenate([np.asarray(x).reshape(-1) for x in got[3:]])
         bad_p_n = int(np.asarray(bad_p_l)[0])
@@ -1230,6 +1343,15 @@ def _finish_mesh_state(app: App, mesh, state, stats, acc) -> None:
     is provably exact: no spills (a spilled key's device value is partial)
     and no value tie at any chip's k boundary (the word tie-break needs
     bytes the device doesn't have)."""
+    from mapreduce_rust_tpu.parallel.shuffle import shard_fill_counts
+
+    try:
+        # Per-chip final distinct-key counts: the hash-class skew signal
+        # the doctor scores (a hot shard here means one chip's merge and
+        # egress carry the job). One readback at finalize, off the stream.
+        stats.mesh_shard_rows = shard_fill_counts(state)
+    except Exception:
+        pass  # telemetry stays best-effort
     k = app.device_select_k
     if k and stats.spill_events == 0:
         from mapreduce_rust_tpu.parallel.topk import topk_candidates
@@ -1339,7 +1461,10 @@ def _stream_sharded(cfg: Config, app: App, inputs, stats, acc, dictionary) -> No
         t0 = time.perf_counter()
         with trace_span("device.drain", steps=n):
             flat = jax.device_get([x for row in batch for x in row[:4]])
-        stats.device_wait_s += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        stats.device_wait_s += dt
+        stats.record_hist("device.drain_s", dt)
+        _sample_device_memory(stats)
         for row, trunc, p_ovf, b_ovf, ev in zip(
             batch, flat[::4], flat[1::4], flat[2::4], flat[3::4]
         ):
@@ -1496,7 +1621,10 @@ def _stream_mesh(cfg: Config, app: App, inputs, stats, acc, dictionary) -> None:
             flat = jax.device_get(
                 [x for (p, b, e, *_rest) in batch for x in (p, b, e)]
             )
-        stats.device_wait_s += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        stats.device_wait_s += dt
+        stats.record_hist("device.drain_s", dt)
+        _sample_device_memory(stats)
         for (p, b, e, evicted, chunks_host, docs_host), p_arr, b_arr, e_arr in zip(
             batch, flat[::3], flat[1::3], flat[2::3]
         ):
@@ -1615,6 +1743,11 @@ def run_job(
     dictionary = new_dictionary(
         cfg, budget_words=cfg.dictionary_budget_words, spill_dir=cfg.work_dir
     )
+    # Compile instrumentation rides every run (cheap: two listeners, a
+    # list append per compile); the slice below scopes the process-global
+    # log to THIS run's interval.
+    _install_compile_listener()
+    compile_log_start = len(_COMPILE_LOG)
     tracer = start_tracing(tag="driver") if cfg.trace_path else None
     if tracer is not None:
         # Flight recorder: the stream loops tick maybe_snapshot() per
@@ -1696,9 +1829,14 @@ def run_job(
                     suffix = f".p{jax.process_index()}" if jax.process_count() > 1 else ""
                     for r in range(cfg.reduce_n):
                         path = os.path.join(cfg.output_dir, f"mr-{r}{suffix}.txt")
+                        written = 0
                         with open(path, "wb") as f:
                             for line in parts.get(r, []):
                                 f.write(line + b"\n")
+                                written += len(line) + 1
+                        # Per-partition output bytes: the reduce-side skew
+                        # signal the doctor scores (index = partition r).
+                        stats.partition_bytes.append(written)
                         output_files.append(path)
 
         stats.wall_seconds = time.perf_counter() - t0
@@ -1709,6 +1847,17 @@ def run_job(
         # post-mortem throughput comparison.
         if not stats.wall_seconds:
             stats.wall_seconds = time.perf_counter() - t0
+        # Fold this run's XLA compiles into the stats (count / seconds /
+        # persistent-cache hit-miss split) — the doctor's compile-bound
+        # attribution and the manifest's "compile" block.
+        for rec in _COMPILE_LOG[compile_log_start:]:
+            stats.compile_count += 1
+            stats.compile_s += rec["dur_s"]
+            if rec["cache"] == "hit":
+                stats.compile_cache_hits += 1
+            elif rec["cache"] == "miss":
+                stats.compile_cache_misses += 1
+            stats.record_hist("xla.compile_s", rec["dur_s"])
         # Spill runs are job-scoped scratch: a shared work_dir must not
         # accumulate accrun-*/dictrun-* files across jobs (or leak them on
         # a failed run) — ADVICE r5. Their counts survive in the stats (and
@@ -1829,6 +1978,10 @@ def _stream_finalize(cfg: Config, app: App, stats: JobStats, acc: HostAccumulato
                 with open(os.path.join(tmpdir, f"part-{r}"), "rb") as f:
                     lines = f.read().splitlines()
                 lines.sort()
+                # Same reduce-skew signal as the in-RAM egress path.
+                stats.partition_bytes.append(
+                    sum(len(line) + 1 for line in lines)
+                )
                 if write_outputs:
                     path = os.path.join(cfg.output_dir, f"mr-{r}.txt")
                     with open(path, "wb") as f:

@@ -14,6 +14,7 @@ import logging
 import time
 from contextlib import contextmanager
 
+from mapreduce_rust_tpu.runtime.histogram import Histogram
 from mapreduce_rust_tpu.runtime.trace import trace_span
 
 log = logging.getLogger("mapreduce_rust_tpu")
@@ -76,6 +77,38 @@ class JobStats:
     host_arena_bytes: int = 0     # native scan scratch resident across ALL
     # scan threads at job end (native/host.arena_bytes): the memory price
     # of host_map_workers, flat per thread by construction
+    # ---- doctor instrumentation (ISSUE 5) ----
+    compile_count: int = 0        # XLA backend compiles this run triggered
+    compile_s: float = 0.0        # wall seconds inside those compiles —
+    # overlaps the phase that triggered them (a cold first window pays it),
+    # so the doctor can name "compile" as the real ceiling of a short run
+    compile_cache_hits: int = 0   # persistent-compilation-cache hits
+    compile_cache_misses: int = 0  # consulted-but-absent (cold) compiles
+    device_mem_high_bytes: int = 0  # high-water bytes_in_use across local
+    # devices, sampled from the existing drain/consume loops (0 when the
+    # backend exposes no memory_stats, e.g. CPU)
+    partition_bytes: list = dataclasses.field(default_factory=list)
+    # bytes of formatted output per reduce partition (index = r): the
+    # reduce-side skew signal the doctor scores — a hot partition here is
+    # the key-distribution problem the reference can't even see
+    mesh_shard_rows: list = dataclasses.field(default_factory=list)
+    # final valid records per mesh shard (hash-class skew across chips)
+    hists: dict = dataclasses.field(default_factory=dict)
+    # name → runtime.histogram.Histogram: the latency distributions behind
+    # the aggregate counters above (host_map.scan_s, a2a.round_s,
+    # device.drain_s, ingest.wait_s, ...). Serialized into the manifest as
+    # "histograms" by telemetry.stats_to_dict; per-window/per-round sites
+    # only — never per-record (the add is a bisect, not free).
+
+    def record_hist(self, name: str, value: float) -> None:
+        """Fold one sample into the named latency/size histogram. Same
+        ownership contract as every other stats write: consumer thread
+        only (the sanitizer's registered-writer gate covers the attribute
+        reads here; the dict insert happens on first use)."""
+        h = self.hists.get(name)
+        if h is None:
+            h = self.hists[name] = Histogram()
+        h.add(value)
 
     def register_writer(self) -> None:
         """Sanitizer hook: announce the calling thread as a legitimate

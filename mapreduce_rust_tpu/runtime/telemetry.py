@@ -30,6 +30,8 @@ import subprocess
 import sys
 import time
 
+from mapreduce_rust_tpu.runtime.histogram import Histogram
+
 MANIFEST_SCHEMA = 1
 
 
@@ -47,7 +49,14 @@ class JobReport:
 
     def __init__(self) -> None:
         self._tasks: dict[tuple[str, int], dict] = {}
-        self._rpc: dict[str, dict] = {}
+        self._rpc: dict[str, Histogram] = {}
+        # Per-worker attribution (ISSUE 5 satellite — the PR 4 leftover):
+        # wid → counters + an attempt-duration histogram. Grants, renewals
+        # and finish reports carry the worker id, so `watch` shows a
+        # per-worker column and the doctor's straggler pass compares each
+        # worker's p50 against the fleet median.
+        self._workers: dict[int, dict] = {}
+        self._phase_hist: dict[str, Histogram] = {}  # attempt durations
         self._t0 = time.monotonic()
 
     def _task(self, phase: str, tid: int) -> dict:
@@ -61,9 +70,26 @@ class JobReport:
                 "reports": 0,
                 "late_reports": 0,
                 "first_grant_s": None,
+                "last_grant_s": None,
                 "done_s": None,
+                "wid": None,
             }
         return t
+
+    def _worker(self, wid) -> "dict | None":
+        if wid is None or (isinstance(wid, int) and wid < 0):
+            return None  # pre-wid client / in-process caller: per-task only
+        w = self._workers.get(wid)
+        if w is None:
+            w = self._workers[wid] = {
+                "grants": 0,
+                "renewals": 0,
+                "stale_renewals": 0,
+                "reports": 0,
+                "late_reports": 0,
+                "task_s": Histogram(),
+            }
+        return w
 
     def attempts(self, phase: str, tid: int) -> int:
         """How many times (phase, tid) has been granted — the attempt
@@ -86,24 +112,35 @@ class JobReport:
     def uptime_s(self) -> float:
         return time.monotonic() - self._t0
 
-    def record_grant(self, phase: str, tid: int) -> None:
+    def record_grant(self, phase: str, tid: int, wid=None) -> None:
         t = self._task(phase, tid)
         t["grants"] += 1
+        now = time.monotonic() - self._t0
         if t["first_grant_s"] is None:
-            t["first_grant_s"] = time.monotonic() - self._t0
+            t["first_grant_s"] = now
+        t["last_grant_s"] = now
+        if wid is not None and not (isinstance(wid, int) and wid < 0):
+            t["wid"] = wid
+        w = self._worker(wid)
+        if w is not None:
+            w["grants"] += 1
 
-    def record_renewal(self, phase: str, tid: int, ok: bool) -> None:
+    def record_renewal(self, phase: str, tid: int, ok: bool, wid=None) -> None:
         # Update-only: a renewal for a task this incarnation never granted
         # (a surviving worker's lease after a journal-resume restart) must
         # not fabricate a grants=0/incomplete phantom entry in the report.
         t = self._tasks.get((phase, tid))
         if t is not None:
             t["renewals" if ok else "stale_renewals"] += 1
+        w = self._worker(wid)
+        if w is not None:
+            w["renewals" if ok else "stale_renewals"] += 1
 
     def record_expiry(self, phase: str, tid: int) -> None:
         self._task(phase, tid)["expiries"] += 1
 
-    def record_finish(self, phase: str, tid: int, late: bool = False) -> None:
+    def record_finish(self, phase: str, tid: int, late: bool = False,
+                      wid=None) -> None:
         # Update-only, like record_renewal: a finish report for a task this
         # incarnation never granted (journal-resume restart) must not
         # fabricate a completed-but-never-granted entry whose duration_s
@@ -111,16 +148,34 @@ class JobReport:
         t = self._tasks.get((phase, tid))
         if t is None:
             return
+        w = self._worker(wid)
         if late:
             # A duplicate completion (original + re-executed worker both
             # reporting the same tid) is a DISTINCT stat, not a second
             # "reports" tick: double-counting skewed task durations and
             # completion totals (ISSUE 4 satellite).
             t["late_reports"] += 1
+            if w is not None:
+                w["late_reports"] += 1
             return
         t["reports"] += 1
         if t["done_s"] is None:
-            t["done_s"] = time.monotonic() - self._t0
+            now = time.monotonic() - self._t0
+            t["done_s"] = now
+            # Attempt duration: this grant → this (first) finish. Under a
+            # re-execution the last grant belongs to the attempt that is
+            # reporting, so per-worker attribution stays honest even when
+            # attempt 1's worker is dead.
+            if t["last_grant_s"] is not None:
+                dur = max(now - t["last_grant_s"], 0.0)
+                h = self._phase_hist.get(phase)
+                if h is None:
+                    h = self._phase_hist[phase] = Histogram()
+                h.add(dur)
+                if w is not None:
+                    w["task_s"].add(dur)
+        if w is not None:
+            w["reports"] += 1
 
     def in_flight(self) -> list[tuple[str, int]]:
         """(phase, tid) of tasks granted but not yet reported finished —
@@ -131,12 +186,25 @@ class JobReport:
         ]
 
     def record_rpc(self, method: str, seconds: float) -> None:
-        r = self._rpc.get(method)
-        if r is None:
-            r = self._rpc[method] = {"count": 0, "total_s": 0.0, "max_s": 0.0}
-        r["count"] += 1
-        r["total_s"] += seconds
-        r["max_s"] = max(r["max_s"], seconds)
+        h = self._rpc.get(method)
+        if h is None:
+            h = self._rpc[method] = Histogram()
+        h.add(seconds)
+
+    def workers_summary(self) -> dict:
+        """wid → counters + attempt-duration percentiles (ms): the live
+        per-worker view `watch` renders and the doctor's straggler input."""
+        out: dict = {}
+        for wid, w in sorted(self._workers.items(), key=lambda kv: str(kv[0])):
+            out[str(wid)] = {
+                "grants": w["grants"],
+                "renewals": w["renewals"],
+                "stale_renewals": w["stale_renewals"],
+                "reports": w["reports"],
+                "late_reports": w["late_reports"],
+                "task_s": w["task_s"].to_dict(),
+            }
+        return out
 
     def to_dict(self) -> dict:
         phases: dict[str, dict] = {}
@@ -156,6 +224,7 @@ class JobReport:
                 "late_reports": t["late_reports"],
                 "duration_s": duration,
                 "completed": t["done_s"] is not None,
+                "wid": t["wid"],
             }
         totals = {
             phase: {
@@ -167,16 +236,31 @@ class JobReport:
             }
             for phase, tasks in phases.items()
         }
+        for phase, h in self._phase_hist.items():
+            if phase in totals:
+                # Attempt-duration distribution (seconds): the doctor's
+                # lease-tuning input (expiries vs task p99).
+                totals[phase]["task_s"] = h.to_dict()
         rpc = {
             m: {
-                "count": r["count"],
-                "total_s": round(r["total_s"], 6),
-                "mean_ms": round(r["total_s"] / r["count"] * 1e3, 3),
-                "max_ms": round(r["max_s"] * 1e3, 3),
+                # Keys preserved from the aggregate-counter era (count /
+                # total_s / mean_ms / max_ms) plus the percentile tail the
+                # doctor reads — all derived from one mergeable histogram.
+                "count": h.count,
+                "total_s": round(h.total, 6),
+                "mean_ms": round(h.mean * 1e3, 3),
+                "p50_ms": round((h.percentile(0.50) or 0.0) * 1e3, 3),
+                "p95_ms": round((h.percentile(0.95) or 0.0) * 1e3, 3),
+                "p99_ms": round((h.percentile(0.99) or 0.0) * 1e3, 3),
+                "max_ms": round(h.max * 1e3, 3),
+                "hist": h.to_dict(),
             }
-            for m, r in sorted(self._rpc.items())
+            for m, h in sorted(self._rpc.items())
         }
-        return {"tasks": phases, "totals": totals, "rpc": rpc}
+        out = {"tasks": phases, "totals": totals, "rpc": rpc}
+        if self._workers:
+            out["workers"] = self.workers_summary()
+        return out
 
     def summary(self) -> str:
         d = self.to_dict()
@@ -233,6 +317,15 @@ def format_progress(stats: dict) -> str:
                 f"lease {lease['lease_remaining_s']:+.1f}s  "
                 f"renewed {since_s}  [{state}]"
             )
+    by_worker = stats.get("workers") or {}
+    for wid, w in sorted(by_worker.items(), key=lambda kv: str(kv[0])):
+        ts = w.get("task_s") or {}
+        p50 = ts.get("p50")
+        lines.append(
+            f"  w{wid}: {w.get('reports', 0)} done · "
+            f"{w.get('grants', 0)} grants · {w.get('renewals', 0)} renewals"
+            + (f" · task p50 {p50:.2f}s" if p50 is not None else "")
+        )
     rpc = stats.get("rpc") or {}
     if rpc:
         calls = sum(r["count"] for r in rpc.values())
@@ -314,6 +407,20 @@ def stats_to_dict(stats) -> dict:
       compute, before any multi-chip perf claim.
     """
     d = dataclasses.asdict(stats)
+    # The raw hists field holds Histogram objects (asdict deep-copies them
+    # verbatim); serialize into the manifest's "histograms" block instead —
+    # sparse buckets + precomputed p50/p95/p99, mergeable across runs.
+    d.pop("hists", None)
+    d["histograms"] = {
+        name: h.to_dict() for name, h in sorted(stats.hists.items())
+    }
+    if stats.compile_count:
+        d["compile"] = {
+            "count": stats.compile_count,
+            "total_s": round(stats.compile_s, 6),
+            "cache_hits": stats.compile_cache_hits,
+            "cache_misses": stats.compile_cache_misses,
+        }
     d["gb_per_s"] = stats.gb_per_s
     d["bottleneck"] = stats.bottleneck
     stream_s = stats.phase_seconds.get("stream", 0.0)
@@ -509,6 +616,28 @@ def format_manifest(m: dict) -> str:
                 f"stream ({ici['rounds']} rounds, "
                 f"{ici['wire_bytes'] / 1e6:.1f} MB wire)"
             )
+        comp = s.get("compile")
+        if comp:
+            lines.append(
+                f"  compile: {comp['count']} XLA compiles, "
+                f"{comp['total_s']:.2f}s ({comp['cache_hits']} cache hits, "
+                f"{comp['cache_misses']} misses)"
+            )
+        if s.get("device_mem_high_bytes"):
+            lines.append(
+                f"  device memory high-water: "
+                f"{s['device_mem_high_bytes'] / 1e6:.1f} MB"
+            )
+        for name, h in sorted((s.get("histograms") or {}).items()):
+            if not h.get("count"):
+                continue
+            unit = 1e3 if name.endswith("_s") else 1.0  # seconds → ms
+            lines.append(
+                f"  hist {name:<18} n={h['count']:<6} "
+                f"p50={h['p50'] * unit:.3g} p95={h['p95'] * unit:.3g} "
+                f"p99={h['p99'] * unit:.3g} max={h['max'] * unit:.3g}"
+                + (" ms" if unit == 1e3 else "")
+            )
     for name, secs in (m.get("phase_seconds") or {}).items():
         lines.append(f"  phase {name:<10} {secs:8.3f}s")
     if m.get("trace_path"):
@@ -527,6 +656,11 @@ def diff_manifests(a: dict, b: dict) -> list[str]:
     lines = []
     for key in sorted(set(fa) | set(fb)):
         if key.startswith(skip) or key in skip:
+            continue
+        # Raw histogram internals (sparse bucket maps, embedded hist
+        # copies): the percentile fields beside them carry the comparable
+        # signal; diffing bucket indexes is noise.
+        if any(seg in ("buckets", "hist") for seg in key.split(".")):
             continue
         va, vb = fa.get(key, "<absent>"), fb.get(key, "<absent>")
         if va == vb:

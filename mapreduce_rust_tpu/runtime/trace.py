@@ -115,8 +115,16 @@ class Tracer:
         self._snap_last_n = 0
         self._snap_lock = threading.Lock()
 
-    def add_span(self, name: str, t0: float, t1: float, args=None) -> None:
-        self._events.append((name, t0, t1, threading.get_ident(), args))
+    def add_span(self, name: str, t0: float, t1: float, args=None,
+                 tid: "int | None" = None) -> None:
+        """``tid`` defaults to the calling thread. Pass an explicit pseudo
+        tid for spans whose interval was measured by SOMEONE ELSE'S clock
+        (e.g. the XLA compile listener re-emits jax-measured durations):
+        on a synthetic track they can never partially overlap this
+        thread's own call-structured spans, which the validator rejects."""
+        self._events.append(
+            (name, t0, t1, threading.get_ident() if tid is None else tid, args)
+        )
 
     def instant(self, name: str, **args) -> None:
         t = time.perf_counter()
@@ -147,23 +155,33 @@ class Tracer:
 
     def summarize(self, name: str) -> "dict | None":
         """Aggregate of the named complete spans — {count, total_s,
-        mean_ms, max_ms} — or None when the buffer holds none. Used at
-        manifest-flush time (one pass over the buffer, off the hot path)
-        to surface e.g. per-round mesh.all_to_all durations without
-        shipping every event into the manifest."""
-        durs = [
+        mean_ms, p50/p95/p99_ms, max_ms} — or None when the buffer holds
+        none. Used at manifest-flush time (one pass over the buffer, off
+        the hot path) to surface e.g. per-round mesh.all_to_all durations
+        without shipping every event into the manifest. Percentiles are
+        exact (sorted sample), not bucketed — the buffer already holds
+        every duration."""
+        durs = sorted(
             t1 - t0
             for n, t0, t1, _tid, _args in self._events
             if n == name and isinstance(t1, float)
-        ]
+        )
         if not durs:
             return None
         total = sum(durs)
+        n = len(durs)
+
+        def pct(q: float) -> float:
+            return durs[min(int(q * (n - 1) + 0.5), n - 1)]
+
         return {
-            "count": len(durs),
+            "count": n,
             "total_s": round(total, 6),
-            "mean_ms": round(total / len(durs) * 1e3, 3),
-            "max_ms": round(max(durs) * 1e3, 3),
+            "mean_ms": round(total / n * 1e3, 3),
+            "p50_ms": round(pct(0.50) * 1e3, 3),
+            "p95_ms": round(pct(0.95) * 1e3, 3),
+            "p99_ms": round(pct(0.99) * 1e3, 3),
+            "max_ms": round(durs[-1] * 1e3, 3),
         }
 
     def events(self, limit: "int | None" = None) -> list[dict]:

@@ -107,6 +107,12 @@ class Worker:
         self.sync = ClockSync()
         self._attempts: dict[tuple[str, int], int] = {}  # (phase, tid) → n
 
+    @property
+    def _wid(self) -> int:
+        """Worker id for RPC attribution (-1 = not yet registered: the
+        coordinator treats it as anonymous, never a phantom worker row)."""
+        return self.worker_id if self.worker_id is not None else -1
+
     # ---- map/reduce engines ----
 
     def _map_table(self, doc_id: int, path: str) -> tuple[dict, Dictionary]:
@@ -298,10 +304,12 @@ class Worker:
                 await asyncio.sleep(self.cfg.lease_renew_period_s)
                 if stop.is_set():
                     return
-                ok = await self._call(client, method, tid)
+                ok = await self._call(client, method, tid, self._wid)
                 if stop.is_set():
                     return  # a swallowed cancel still exits here
-                self.report.record_renewal(self._phase_name(method), tid, bool(ok))
+                self.report.record_renewal(
+                    self._phase_name(method), tid, bool(ok), wid=self._wid
+                )
                 # Snapshot AFTER the renewal is on the wire: under GIL
                 # contention with the compute thread the snapshot's IO can
                 # take 100s of ms, and the heartbeat must never queue
@@ -324,7 +332,10 @@ class Worker:
         phase = self._phase_name(get)
         while True:
             try:
-                tid = await self._call(client, get)
+                # The worker id rides on every task RPC so the coordinator
+                # attributes grants/renewals/finishes per worker (the
+                # `watch` worker column + doctor straggler input).
+                tid = await self._call(client, get, self._wid)
             except ConnectionError:
                 # Coordinator exited between our WAIT poll and this call —
                 # the job completed while we slept. A clean end, not a crash.
@@ -339,7 +350,7 @@ class Worker:
                 maybe_snapshot()
                 await asyncio.sleep(self.cfg.poll_retry_s)
                 continue
-            self.report.record_grant(phase, tid)
+            self.report.record_grant(phase, tid, wid=self._wid)
             # The grant response carried the coordinator's attempt number:
             # the task span joins that attempt's flow chain.
             self._attempts[(phase, tid)] = client.last_attempt or 1
@@ -365,8 +376,8 @@ class Worker:
                 await asyncio.gather(renewal, return_exceptions=True)
                 await renew_client.close()
             await self._call(client, report, tid,
-                             self._attempts.get((phase, tid), 0))
-            self.report.record_finish(phase, tid)
+                             self._attempts.get((phase, tid), 0), self._wid)
+            self.report.record_finish(phase, tid, wid=self._wid)
             maybe_snapshot()
 
     async def run(self) -> None:

@@ -226,7 +226,9 @@ def test_progress_view_tracks_lease_liveness(tmp_path):
     c.report_map_task_finish(0, 1)
     p = c.progress()
     assert p["phase"] == "map" and p["done"] is False
-    assert p["workers"] == {"registered": 1, "expected": 1}
+    assert p["workers"]["registered"] == 1 and p["workers"]["expected"] == 1
+    # Anonymous (wid-less) callers never fabricate a per-worker block.
+    assert "workers" not in c.report.to_dict()
     m = p["phases"]["map"]
     assert m["tasks_total"] == 3 and m["issued"] == 2
     assert m["done"] == 1 and m["in_flight"] == 1 and m["pending"] == 1
@@ -249,6 +251,57 @@ def test_progress_view_tracks_lease_liveness(tmp_path):
     text = format_progress(c.stats())
     assert "phase map" in text and "1 expired" in text
     assert "attempt 2" in text
+
+
+def test_per_worker_wid_attribution(tmp_path):
+    # ISSUE 5 satellite (PR 4 leftover): grants, renewals and finishes
+    # carry the worker id, so the stats/progress view grows a per-worker
+    # column and the doctor's straggler pass has per-worker duration
+    # histograms to compare.
+    cfg = make_cfg(tmp_path, 3, worker_n=2)
+    c = Coordinator(cfg)
+    c.get_worker_id()
+    c.get_worker_id()
+    assert c.get_map_task(0) == 0
+    assert c.get_map_task(1) == 1
+    assert c.renew_map_lease(0, 0) is True
+    assert c.renew_map_lease(1, 1) is True
+    c.report_map_task_finish(0, 1, 0)
+    c.report_map_task_finish(1, 1, 1)
+    rep = c.stats()
+    # Per-task rows name their worker; the workers block aggregates.
+    assert rep["tasks"]["map"]["0"]["wid"] == 0
+    assert rep["tasks"]["map"]["1"]["wid"] == 1
+    w0, w1 = rep["workers"]["0"], rep["workers"]["1"]
+    assert w0["grants"] == 1 and w0["reports"] == 1 and w0["renewals"] == 1
+    assert w1["grants"] == 1 and w1["reports"] == 1
+    # Attempt durations landed in the per-worker histogram (seconds).
+    assert w0["task_s"]["count"] == 1 and w0["task_s"]["p50"] >= 0
+    # Phase totals carry the fleet-wide attempt-duration distribution —
+    # the doctor's lease-tuning input.
+    assert rep["totals"]["map"]["task_s"]["count"] == 2
+    # The stats response carries the per-worker block exactly once (the
+    # top-level "workers" from JobReport.to_dict — progress() does not
+    # duplicate it), and watch renders it as the per-worker column.
+    assert "by_worker" not in rep["progress"]["workers"]
+    from mapreduce_rust_tpu.runtime.telemetry import format_progress
+
+    text = format_progress(rep)
+    assert "w0:" in text and "w1:" in text
+
+
+def test_rpc_latency_percentiles_in_stats(tmp_path):
+    # record_rpc is histogram-backed: the stats RPC serves p50/p95/p99
+    # beside the legacy count/mean/max keys.
+    cfg = make_cfg(tmp_path, 1, worker_n=1)
+    c = Coordinator(cfg)
+    for ms in (1, 2, 3, 50):
+        c.report.record_rpc("get_map_task", ms / 1e3)
+    r = c.stats()["rpc"]["get_map_task"]
+    assert r["count"] == 4
+    assert r["p50_ms"] <= r["p95_ms"] <= r["p99_ms"] <= r["max_ms"] + 1e-9
+    assert 25 <= r["max_ms"] <= 75
+    assert r["hist"]["count"] == 4  # mergeable raw form rides along
 
 
 def test_rpc_timeout_surfaces_wedged_coordinator(tmp_path):

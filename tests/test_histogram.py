@@ -1,0 +1,106 @@
+"""runtime/histogram.py: the doctor's percentile primitive — log-bucket
+accuracy, merge semantics, serialization round trip, edge behavior."""
+
+import json
+import random
+
+import pytest
+
+from mapreduce_rust_tpu.runtime.histogram import EDGES, Histogram
+
+
+def test_empty_histogram():
+    h = Histogram()
+    assert len(h) == 0
+    assert h.percentile(0.5) is None
+    assert h.to_dict()["count"] == 0
+    assert h.summary() == {"count": 0}
+
+
+def test_single_sample_percentiles_are_exact():
+    h = Histogram()
+    h.add(0.0123)
+    for q in (0.0, 0.5, 0.99, 1.0):
+        assert h.percentile(q) == pytest.approx(0.0123)
+    assert h.min == h.max == pytest.approx(0.0123)
+
+
+def test_percentiles_track_known_distribution():
+    # 1000 log-uniform samples: bucketed percentiles must land within one
+    # bucket width (10^0.2 ≈ 1.58x) of the exact sample percentiles.
+    rng = random.Random(7)
+    samples = sorted(10 ** rng.uniform(-5, 1) for _ in range(1000))
+    h = Histogram()
+    for s in samples:
+        h.add(s)
+    for q in (0.5, 0.95, 0.99):
+        exact = samples[int(q * (len(samples) - 1))]
+        got = h.percentile(q)
+        assert exact / 1.6 <= got <= exact * 1.6, (q, exact, got)
+    assert h.max == samples[-1]
+    assert h.total == pytest.approx(sum(samples))
+
+
+def test_out_of_range_values_clamp_to_extremes():
+    h = Histogram()
+    h.add(0.0)          # below the lowest edge → underflow bucket
+    h.add(-1.0)         # negative: still counted, percentile clamps to min
+    h.add(1e9)          # beyond the highest edge → overflow bucket
+    assert h.count == 3
+    assert h.percentile(0.01) == -1.0
+    assert h.percentile(1.0) == 1e9
+
+
+def test_merge_equals_union():
+    rng = random.Random(3)
+    xs = [10 ** rng.uniform(-6, 2) for _ in range(400)]
+    a, b, u = Histogram(), Histogram(), Histogram()
+    for i, x in enumerate(xs):
+        (a if i % 2 else b).add(x)
+        u.add(x)
+    a.merge(b)
+    assert a.count == u.count and a.buckets == u.buckets
+    assert a.min == u.min and a.max == u.max
+    assert a.total == pytest.approx(u.total)
+    for q in (0.5, 0.95, 0.99):
+        assert a.percentile(q) == u.percentile(q)
+
+
+def test_serialization_roundtrip_is_json_safe_and_mergeable():
+    h = Histogram()
+    for v in (1e-4, 2e-4, 5e-3, 0.1, 0.1, 7.0):
+        h.add(v)
+    d = json.loads(json.dumps(h.to_dict()))  # JSON-safe by construction
+    assert d["count"] == 6
+    assert d["p50"] <= d["p95"] <= d["p99"] <= d["max"]
+    h2 = Histogram.from_dict(d)
+    assert h2.count == h.count and h2.buckets == h.buckets
+    for q in (0.5, 0.99):
+        assert h2.percentile(q) == h.percentile(q)
+    # Round-tripped histograms keep merging bucket-for-bucket.
+    h2.merge(Histogram.from_dict(d))
+    assert h2.count == 12
+
+
+def test_summary_scaling():
+    h = Histogram()
+    h.add(0.050)
+    s = h.summary(scale=1e3, digits=3)  # seconds → ms
+    assert s["count"] == 1
+    assert s["p50"] == pytest.approx(50.0)
+    assert s["max"] == pytest.approx(50.0)
+
+
+def test_bucket_edges_are_fixed_and_monotonic():
+    # The merge contract depends on every histogram sharing one scheme.
+    assert len(EDGES) == 61
+    assert all(a < b for a, b in zip(EDGES, EDGES[1:]))
+    assert EDGES[0] == pytest.approx(1e-7)
+    assert EDGES[-1] == pytest.approx(1e5)
+
+
+def test_quantile_bounds_raise():
+    h = Histogram()
+    h.add(1.0)
+    with pytest.raises(ValueError):
+        h.percentile(1.5)

@@ -387,6 +387,78 @@ def test_jit_in_loop_silent_outside_loops_and_on_cached_factories(tmp_path):
 # suppression mechanics + output formats
 # ---------------------------------------------------------------------------
 
+def test_psum_replicated_flag_fires_on_nested_psum(tmp_path):
+    assert rules_fired(tmp_path, """
+        import jax
+
+        def round_flag(flags, AXIS):
+            return jax.lax.psum(jax.lax.psum(flags, AXIS), AXIS)
+    """) == ["psum-replicated-flag"]
+
+
+def test_psum_replicated_flag_fires_on_repsummed_name(tmp_path):
+    findings, _ = run_lint(tmp_path, """
+        import jax
+
+        def tail(p_ovf, AXIS):
+            p_tot = jax.lax.psum(p_ovf, AXIS)
+            # the misuse: p_tot is identical on every chip already —
+            # psumming it again multiplies the flag by D
+            return jax.lax.psum(p_tot, AXIS)
+    """)
+    assert [f.rule for f in findings] == ["psum-replicated-flag"]
+    assert "axis size" in findings[0].message
+
+
+def test_psum_replicated_flag_silent_on_single_psum(tmp_path):
+    # The shipped pattern (_chip_shuffle_tail / make_round_fn): per-chip
+    # counters psum exactly once, the replicated total is then read or
+    # compared, never re-psummed.
+    assert rules_fired(tmp_path, """
+        import jax
+
+        def tail(p_ovf, b_ovf, local, AXIS, clamp_batch):
+            p_tot = jax.lax.psum(p_ovf, AXIS)
+            b_tot = jax.lax.psum(b_ovf, AXIS)
+            return clamp_batch(local, (p_tot + b_tot) == 0)
+    """) == []
+
+
+def test_psum_replicated_flag_silent_on_single_psum_rebinding(tmp_path):
+    # `x = psum(x, AXIS)` is ONE psum whose argument is the pre-assignment
+    # per-chip value — the definition must not poison its own call site.
+    assert rules_fired(tmp_path, """
+        import jax
+
+        def tail(flags, AXIS):
+            flags = jax.lax.psum(flags, AXIS)
+            return flags
+    """) == []
+    # ...but re-psumming the rebound name LATER is still the bug.
+    assert rules_fired(tmp_path, """
+        import jax
+
+        def tail(flags, AXIS):
+            flags = jax.lax.psum(flags, AXIS)
+            return jax.lax.psum(flags, AXIS)
+    """) == ["psum-replicated-flag"]
+
+
+def test_psum_replicated_flag_scopes_per_function(tmp_path):
+    # A replicated name in one function must not poison an unrelated
+    # function's single psum of a same-named per-chip value.
+    assert rules_fired(tmp_path, """
+        import jax
+
+        def a(x, AXIS):
+            tot = jax.lax.psum(x, AXIS)
+            return tot
+
+        def b(tot, AXIS):
+            return jax.lax.psum(tot, AXIS)  # its OWN per-chip arg
+    """) == []
+
+
 BAD_SNIPPET = """
     def shard(dictionary):
         return list(dictionary.items())

@@ -273,6 +273,29 @@ def test_flight_recorder_survives_sigkill(tmp_path):
     assert sum(t["expiries"] for t in report["totals"].values()) >= 1
     assert sum(t["re_executions"] for t in report["totals"].values()) >= 1
 
+    # Doctor on the CRASHED run (ISSUE 5 satellite): coordinator manifest
+    # + merged trace + job report → a diagnosis that flags the SIGKILLed
+    # attempt's unterminated chain, instead of crashing on the partials.
+    r = subprocess.run(
+        [sys.executable, "-m", "mapreduce_rust_tpu", "doctor",
+         str(tmp_path / "manifest-coord.json"),
+         "--trace", str(merged),
+         "--job-report", str(tmp_path / "work" / "job_report.json"),
+         "--format", "json"],
+        capture_output=True, text=True, timeout=60, env=_env(), cwd=REPO,
+    )
+    assert r.returncode == 0, r.stderr
+    diag = json.loads(r.stdout)
+    # Every re-executed fork's dead attempt shows as an incomplete chain.
+    assert set(diag["incomplete"]["flows"]) >= {
+        fid.rsplit(":", 1)[0] + ":1" for fid in reexecuted
+        if "f" not in chains[fid.rsplit(":", 1)[0] + ":1"]
+    }
+    codes = {f["code"] for f in diag["findings"]}
+    assert "incomplete-chain" in codes and "re-execution" in codes
+    # wid attribution made it end-to-end: the report names both workers.
+    assert len(report.get("workers", {})) >= 1
+
 
 # ---- merge unit semantics (no sockets) ----
 

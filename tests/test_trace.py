@@ -319,10 +319,19 @@ def test_word_count_trace_and_manifest_end_to_end(tmp_path):
     from mapreduce_rust_tpu.runtime.metrics import JobStats
 
     for f in dataclasses.fields(JobStats):
-        assert f.name in s, f"manifest stats missing {f.name}"
+        # The raw Histogram store serializes under "histograms" (sparse
+        # buckets + precomputed percentiles), not as the live objects.
+        want = "histograms" if f.name == "hists" else f.name
+        assert want in s, f"manifest stats missing {want}"
     for key in ("ingest_wait_s", "device_wait_s", "host_map_s",
                 "host_glue_s", "shuffle_wire_bytes", "gb_per_s", "bottleneck"):
         assert key in s
+    # The hot paths we used to only sum now carry distributions: the
+    # ingest/drain histograms exist with counts and percentile fields.
+    hists = s["histograms"]
+    assert hists["device.drain_s"]["count"] > 0
+    for key in ("p50", "p95", "p99", "max", "buckets"):
+        assert key in hists["device.drain_s"]
     assert s["distinct_keys"] == len(oracle())
     assert m["phase_seconds"].keys() >= {"stream", "finalize", "egress"}
 
