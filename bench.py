@@ -852,6 +852,192 @@ def sweep_host_workers(spec: str) -> None:
     print(json.dumps(result))
 
 
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+_CHAOS_TEXTS = [
+    b"the quick brown fox jumps over the lazy dog " * 400,
+    b"pack my box with five dozen liquor jugs " * 400,
+    b"sphinx of black quartz judge my vow " * 400,
+]
+
+
+def _chaos_cluster(name: str, work_root: pathlib.Path, chaos_spec: str | None,
+                   speculate: bool, timeout_s: int = 120,
+                   trace: bool = False) -> dict:
+    """One chaos leg: coordinator + 2 worker OS processes over TCP (the
+    REAL binaries — the recovery paths under test live in the real
+    renewal/report loops, not a harness reimplementation). Faults ride in
+    as MR_CHAOS on BOTH workers: the seeded spec targets (phase, tid, wid),
+    so which OS process draws which task stays irrelevant. Returns wall
+    time (coordinator exit = job complete), output bytes, the leg dir
+    ("dir": job_report.json and trace files live under it), and the
+    coordinator manifest path for the doctor. SHARED with the chaos test
+    suite (tests/test_chaos.py drives this same harness), so the benched
+    cluster and the tested cluster can never drift apart."""
+    leg = work_root / name
+    docs = leg / "in"
+    docs.mkdir(parents=True)
+    for i, t in enumerate(_CHAOS_TEXTS):
+        (docs / f"doc-{i}.txt").write_bytes(t)
+    port = _free_port()
+    manifest = leg / "manifest.json"
+    common = [
+        "--input", str(docs), "--output", str(leg / "out"),
+        "--work", str(leg / "work"), "--port", str(port), "--reduce-n", "3",
+        "--lease-timeout", "2.0", "--lease-check-period", "0.3",
+        "--renew-period", "0.3", "--poll-retry", "0.05",
+    ]
+    if trace:
+        common += ["--trace", str(leg / "trace.json")]
+    coord_args = ["--worker-n", "2", "--manifest", str(manifest), *common]
+    if speculate:
+        coord_args += ["--speculate", "--speculate-after-frac", "0.5"]
+    env = _cpu_env()  # control-plane recovery needs no accelerator; a
+    # wedged tunnel must not cost us the chaos matrix
+    env["PYTHONPATH"] = str(REPO)
+    worker_env = dict(env)
+    if chaos_spec:
+        worker_env["MR_CHAOS"] = chaos_spec
+    t0 = time.perf_counter()
+    coord = subprocess.Popen(
+        [sys.executable, "-m", "mapreduce_rust_tpu", "coordinator", *coord_args],
+        env=env, cwd=str(REPO), stderr=subprocess.DEVNULL,
+    )
+    workers = [
+        subprocess.Popen(
+            [sys.executable, "-m", "mapreduce_rust_tpu", "worker",
+             "--engine", "host", *common],
+            env=worker_env, cwd=str(REPO), stderr=subprocess.DEVNULL,
+        )
+        for _ in range(2)
+    ]
+    result: dict = {"scenario": name, "speculate": speculate}
+    try:
+        rc = coord.wait(timeout=timeout_s)
+        result["wall_s"] = round(time.perf_counter() - t0, 3)
+        result["recovered"] = rc == 0
+        for w in workers:
+            try:
+                w.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                w.kill()
+                result["recovered"] = False
+    except subprocess.TimeoutExpired:
+        result["recovered"] = False
+        result["error"] = f"coordinator did not finish within {timeout_s}s"
+    finally:
+        for p in [coord, *workers]:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    result["outputs"] = {
+        p.name: p.read_bytes()
+        for p in sorted((leg / "out").glob("mr-*.txt"))
+    }
+    # The coordinator writes its manifest under the per-process name
+    # (manifest-coord.json): co-hosted processes never clobber each other.
+    from mapreduce_rust_tpu.runtime.trace import per_process_path
+
+    coord_manifest = pathlib.Path(per_process_path(str(manifest), "coord"))
+    if coord_manifest.exists():
+        result["manifest"] = str(coord_manifest)
+    result["dir"] = str(leg)
+    return result
+
+
+def chaos_legs() -> None:
+    """``bench.py --chaos``: the seeded fault-injection matrix
+    (analysis/chaos.SCENARIOS) over the real control plane. Each scenario
+    measures recovery cost (wall vs the fault-free baseline), checks the
+    outputs stay BIT-IDENTICAL to the fault-free run, runs the doctor on
+    the coordinator manifest, and appends a line to .bench/history.jsonl.
+    The slow_scan scenario runs twice — speculation OFF then ON — so the
+    history carries the measured speculation win. Prints ONE JSON line;
+    exits 1 if any scenario failed to recover or diverged."""
+    import shutil
+
+    from mapreduce_rust_tpu.analysis.chaos import SCENARIOS
+    from mapreduce_rust_tpu.analysis.doctor import diagnose
+    from mapreduce_rust_tpu.runtime.telemetry import load_manifest
+
+    work_root = BENCH_DIR / "chaos"
+    shutil.rmtree(work_root, ignore_errors=True)
+    legs: list[tuple[str, str | None, bool]] = [("baseline", None, False)]
+    for name, spec in SCENARIOS.items():
+        if name == "slow_scan":
+            legs.append(("slow_scan-nospec", spec, False))
+            legs.append(("slow_scan-spec", spec, True))
+        else:
+            legs.append((name, spec, False))
+    baseline_outputs = None
+    baseline_wall = None
+    rows = []
+    ok = True
+    for name, spec, speculate in legs:
+        r = _chaos_cluster(name, work_root, spec, speculate)
+        outputs = r.pop("outputs")
+        if name == "baseline":
+            baseline_outputs, baseline_wall = outputs, r.get("wall_s")
+            r["bit_identical"] = True
+        else:
+            r["bit_identical"] = outputs == baseline_outputs
+            if baseline_wall is not None and r.get("wall_s") is not None:
+                r["recovery_cost_s"] = round(r["wall_s"] - baseline_wall, 3)
+        if r.get("manifest"):
+            try:
+                diag = diagnose(load_manifest(r["manifest"]))
+                r["doctor"] = {
+                    "findings": [
+                        f"[{f['severity']}] {f['code']}: {f['message']}"
+                        for f in (diag.get("findings") or [])[:6]
+                    ],
+                    "speculation": diag.get("speculation"),
+                }
+            except Exception as e:
+                r["doctor"] = {"error": repr(e)}
+        ok = ok and r.get("recovered", False) and r["bit_identical"]
+        rows.append(r)
+        print(f"chaos {name}: wall={r.get('wall_s')}s recovered="
+              f"{r.get('recovered')} identical={r['bit_identical']}",
+              file=sys.stderr)
+        _append_history({
+            "metric": f"chaos recovery ({name})",
+            "value": None,  # chaos rows must not pollute the trend series
+            "unit": "s",
+            "platform": "cpu",
+            "doctor": r.get("doctor"),
+            "chaos_scenario": name,
+            "chaos_wall_s": r.get("wall_s"),
+            "chaos_recovery_cost_s": r.get("recovery_cost_s"),
+            "chaos_bit_identical": r["bit_identical"],
+            "chaos_speculate": speculate,
+        })
+    nospec = next((r for r in rows if r["scenario"] == "slow_scan-nospec"), None)
+    spec = next((r for r in rows if r["scenario"] == "slow_scan-spec"), None)
+    result = {
+        "metric": "chaos matrix: seeded fault recovery, wall seconds per "
+                  "scenario (coordinator+2 workers, host engine, cpu)",
+        "unit": "s",
+        "ok": ok,
+        "baseline_wall_s": baseline_wall,
+        "scenarios": rows,
+        "speculation_speedup": (
+            round(nospec["wall_s"] / spec["wall_s"], 2)
+            if nospec and spec and nospec.get("wall_s") and spec.get("wall_s")
+            else None
+        ),
+    }
+    print(json.dumps(result))
+    if not ok:
+        raise SystemExit(1)
+
+
 def main() -> None:
     errors: list[str] = []
     base_gbs = None
@@ -1054,6 +1240,17 @@ def _append_history(result: dict) -> None:
             "zipf_gbs": (result.get("zipf") or {}).get("gbs"),
             "had_errors": bool(result.get("error")),
         }
+        # Chaos rows (bench.py --chaos) carry their scenario fields
+        # verbatim; their "value" stays None so `doctor trend`'s watched
+        # series never mix recovery walls with throughput numbers.
+        line.update({
+            k: v for k, v in result.items() if k.startswith("chaos_")
+        })
+        if result.get("chaos_scenario"):
+            line["doctor_findings"] = [
+                f.split(": ", 1)[0]
+                for f in ((result.get("doctor") or {}).get("findings") or [])
+            ]
         BENCH_DIR.mkdir(exist_ok=True)
         with open(BENCH_DIR / "history.jsonl", "a") as f:
             f.write(json.dumps(line) + "\n")
@@ -1193,9 +1390,22 @@ if __name__ == "__main__":
                 f"--host-workers needs a positive integer, got {_workers!r}"
             )
         os.environ["BENCH_HOST_WORKERS"] = _workers
+    _chaos = _take_switch(_argv, "--chaos")
     _sweep = _take_flag(_argv, "--sweep-host-workers")
     sys.argv = [sys.argv[0]] + _argv
-    if _sweep:
+    if _chaos:
+        try:
+            chaos_legs()
+        except SystemExit:
+            raise
+        except BaseException as e:  # one JSON line, like the main harness
+            print(json.dumps({
+                "metric": "chaos matrix: seeded fault recovery",
+                "unit": "s", "ok": False, "scenarios": None,
+                "error": f"chaos harness: {e!r}",
+            }))
+            raise SystemExit(1)
+    elif _sweep:
         try:
             sweep_host_workers(_sweep)
         except BaseException as e:  # one JSON line, like the main harness

@@ -54,6 +54,11 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--renew-period", type=float, default=1.0,
                    dest="renew_period",
                    help="worker lease-renewal period (seconds)")
+    p.add_argument("--poll-retry", type=float, default=1.0,
+                   dest="poll_retry",
+                   help="worker BASE sleep on the -2/-3 sentinels "
+                   "(seconds); the poll backs off exponentially from here "
+                   "up to 4x (jittered), resetting on a real grant")
     p.add_argument("--chunk-mb", type=float, default=4.0)
     p.add_argument("--device", default="auto", choices=["auto", "tpu", "cpu"])
     p.add_argument("--profile-dir", default=None,
@@ -70,6 +75,12 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="thread-ownership sanitizer: cross-thread writes to "
                    "JobStats/the egress dictionary and scan-arena aliasing "
                    "raise at the fault site (also: MR_SANITIZE=1 env)")
+    p.add_argument("--chaos", default=None, metavar="SPEC",
+                   help="deterministic fault injection (analysis/chaos.py "
+                   "grammar): seeded faults at named worker sites, e.g. "
+                   "'seed=7;pause:map:0:2.0;kill:reduce:1'. Sites: pause, "
+                   "kill, drop_finish, delay_finish, wedge_renewal, "
+                   "slow_scan. MR_CHAOS in the environment overrides")
     p.add_argument("-v", "--verbose", action="store_true")
 
 
@@ -80,6 +91,18 @@ def _cfg(args, map_n: int = 1, worker_n: int = 1) -> Config:
         # any child process must see the same enablement as Config.sanitize
         # — bench.py does the same for its legs.
         os.environ["MR_SANITIZE"] = "1"
+    chaos = getattr(args, "chaos", None)
+    if chaos:
+        from mapreduce_rust_tpu.analysis.chaos import ChaosPlan
+
+        try:
+            ChaosPlan.parse(chaos)  # a typo'd spec is a CLI usage error,
+            # not a mid-run traceback inside a worker
+        except ValueError as e:
+            parser = getattr(args, "_parser", None)
+            if parser is not None:
+                parser.error(str(e))
+            raise
     return Config(
         map_n=max(map_n, 1),
         reduce_n=args.reduce_n,
@@ -103,6 +126,10 @@ def _cfg(args, map_n: int = 1, worker_n: int = 1) -> Config:
         lease_timeout_s=getattr(args, "lease_timeout", 5.0),
         lease_check_period_s=getattr(args, "lease_check_period", 5.0),
         lease_renew_period_s=getattr(args, "renew_period", 1.0),
+        poll_retry_s=getattr(args, "poll_retry", 1.0),
+        speculate=getattr(args, "speculate", False),
+        speculate_after_frac=getattr(args, "speculate_after_frac", 0.75),
+        chaos=chaos,
         input_dir=args.input,
         input_pattern=args.pattern,
         work_dir=args.work,
@@ -182,8 +209,36 @@ def cmd_worker(args) -> int:
     inputs = list_inputs(args.input, args.pattern)
     cfg = _cfg(args, map_n=len(inputs))
     worker = Worker(cfg, app=_app(args), engine=args.engine)
+    _arm_worker_drain(worker)
     asyncio.run(worker.run())
     return 0
+
+
+def _arm_worker_drain(worker) -> None:
+    """SIGTERM = graceful drain for a CLI worker: finish the current task,
+    report it, deregister, exit 0 — replacing the crash-dump handler's
+    immediate re-raise (the flight-recorder snapshot still happens here).
+    A SECOND SIGTERM falls through to the default disposition, so an
+    operator who really means "die now" still can. Installed only by the
+    CLI — embedded/test workers keep their own signal handling."""
+    import signal
+
+    from mapreduce_rust_tpu.runtime.trace import active_tracer
+
+    def _on_term(signum, frame):
+        tr = active_tracer()
+        if tr is not None:
+            try:
+                tr.maybe_snapshot(force=True)
+            except Exception:
+                pass  # draining must not die on a telemetry error
+        worker.request_drain()
+        signal.signal(signum, signal.SIG_DFL)
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+    except (ValueError, OSError):
+        pass  # not the main thread: drain stays reachable via request_drain()
 
 
 def cmd_merge(args) -> int:
@@ -409,6 +464,16 @@ def main(argv: list[str] | None = None) -> int:
     p = sub.add_parser("coordinator", help="control-plane scheduler")
     _add_common(p)
     p.add_argument("--worker-n", type=int, default=1)
+    p.add_argument("--speculate", action="store_true",
+                   help="speculative re-execution: near phase end, re-issue "
+                   "the slowest in-flight task to an idle worker as a new "
+                   "attempt — first finish wins, the loser is revoked on "
+                   "its next lease renewal (outputs stay bit-identical: "
+                   "the finish journal is idempotent)")
+    p.add_argument("--speculate-after-frac", type=float, default=0.75,
+                   dest="speculate_after_frac",
+                   help="fraction of a phase's tasks that must be done "
+                   "before speculation arms (default 0.75)")
 
     p = sub.add_parser("worker", help="pull-based worker process")
     _add_common(p)
@@ -462,7 +527,19 @@ def main(argv: list[str] | None = None) -> int:
         "percentiles, skew/straggler/lease findings, regression gate",
     )
     p.add_argument("manifest", help="run (or coordinator/bench) manifest to "
-                   "diagnose")
+                   "diagnose — or the literal 'trend' to analyze a bench "
+                   "history for sustained drift")
+    p.add_argument("history", nargs="?", default=None,
+                   help="with 'trend': the history file (default "
+                   ".bench/history.jsonl) — exit 1 on sustained drift of a "
+                   "watched series (slope + last-vs-median over --window "
+                   "rounds), the regression class the pairwise gate misses")
+    p.add_argument("--window", type=int, default=8,
+                   help="trend: rounds to analyze (default 8)")
+    p.add_argument("--drift-threshold", type=float, default=0.10,
+                   dest="drift_threshold",
+                   help="trend: relative drift across the window that "
+                   "counts as sustained (default 0.10)")
     p.add_argument("--trace", default=None, metavar="TRACE",
                    help="trace file (merged or per-process, partials "
                    "accepted): enables attempt-chain crash forensics")

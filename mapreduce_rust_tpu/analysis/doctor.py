@@ -327,6 +327,29 @@ def diagnose(manifest: dict, job_report: "dict | None" = None,
                  f"{straggler_factor:.1f}x the fleet median — a slow host, "
                  "an oversubscribed core, or skewed inputs")
 
+    # ---- speculation effectiveness (ISSUE 6) ----
+    if report:
+        spec_tot = {"attempts": 0, "won": 0, "wasted": 0, "time_saved_s": 0.0}
+        for tot in (report.get("totals") or {}).values():
+            s = tot.get("speculation")
+            if s:
+                for k in spec_tot:
+                    spec_tot[k] += s.get(k, 0) or 0
+        if spec_tot["attempts"]:
+            spec_tot["time_saved_s"] = round(spec_tot["time_saved_s"], 4)
+            diag["speculation"] = spec_tot
+            find("info", "speculation-effectiveness",
+                 f"{spec_tot['won']} of {spec_tot['attempts']} speculative "
+                 f"attempt(s) won the race ({spec_tot['wasted']} wasted), "
+                 f"~{spec_tot['time_saved_s']:.2f}s saved vs lease-expiry-"
+                 "only recovery")
+            if spec_tot["attempts"] >= 3 and spec_tot["won"] == 0:
+                find("warn", "speculation-wasteful",
+                     f"all {spec_tot['attempts']} speculative attempts lost "
+                     "their race — the originals finish first; raise "
+                     "--speculate-after-frac or the slow factor so only "
+                     "genuine stragglers get duplicated")
+
     # ---- lease tuning ----
     lease_s = (manifest.get("config") or {}).get("lease_timeout_s")
     if report and lease_s:
@@ -425,6 +448,141 @@ def diagnose(manifest: dict, job_report: "dict | None" = None,
 
 
 # ---------------------------------------------------------------------------
+# Trend: N-round drift detection over .bench/history.jsonl (ISSUE 6)
+# ---------------------------------------------------------------------------
+
+#: History-line series the trend gate watches: field → the direction that
+#: is BAD ("down": a decline regresses — these are GB/s-class metrics).
+TREND_SERIES: dict[str, str] = {
+    "value": "down",
+    "zipf_gbs": "down",
+}
+
+
+def _least_squares_slope(ys: list) -> float:
+    """Slope of y over index 0..n-1 (ordinary least squares)."""
+    n = len(ys)
+    xbar = (n - 1) / 2.0
+    ybar = sum(ys) / n
+    num = sum((i - xbar) * (y - ybar) for i, y in enumerate(ys))
+    den = sum((i - xbar) ** 2 for i in range(n))
+    return num / den if den else 0.0
+
+
+def analyze_trend(lines: list, window: int = 8,
+                  drift_threshold: float = 0.10,
+                  min_points: int = 4) -> dict:
+    """Sustained-drift detection the pairwise ``--baseline`` gate misses:
+    a metric that loses 3% every round never trips a 10% pair threshold
+    but is down 27% after nine rounds. Over the last ``window`` points of
+    each watched series: the least-squares slope (normalized to relative
+    drift across the window) AND last-vs-median must both point the bad
+    way beyond threshold — slope alone would flag an old, recovered dip;
+    last-vs-median alone would flag a single noisy round."""
+    series: dict[str, list] = {k: [] for k in TREND_SERIES}
+    for ln in lines:
+        if not isinstance(ln, dict):
+            continue
+        for key in TREND_SERIES:
+            v = ln.get(key)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                series[key].append(float(v))
+    out: dict = {
+        "schema": DOCTOR_SCHEMA,
+        "kind": "doctor_trend",
+        "window": window,
+        "threshold": drift_threshold,
+        "rounds_seen": len(lines),
+        "series": {},
+        "drifts": [],
+    }
+    for key, ys in series.items():
+        if len(ys) < min_points:
+            out["series"][key] = {"points": len(ys), "status": "insufficient"}
+            continue
+        win = ys[-window:]
+        med = sorted(win)[(len(win) - 1) // 2]
+        slope = _least_squares_slope(win)
+        scale = abs(med) or 1.0
+        rel_drift = slope * (len(win) - 1) / scale  # over the whole window
+        last_vs_median = (win[-1] - med) / scale
+        bad = TREND_SERIES[key]
+        sign = -1.0 if bad == "down" else 1.0
+        drifting = (
+            sign * rel_drift > drift_threshold
+            and sign * last_vs_median > drift_threshold / 2
+        )
+        entry = {
+            "points": len(win),
+            "median": round(med, 6),
+            "last": round(win[-1], 6),
+            "slope_per_round": round(slope, 6),
+            "rel_drift_over_window": round(rel_drift, 4),
+            "last_vs_median": round(last_vs_median, 4),
+            "bad_direction": bad,
+            "status": "drifting" if drifting else "stable",
+        }
+        out["series"][key] = entry
+        if drifting:
+            out["drifts"].append({"metric": key, **entry})
+    return out
+
+
+def format_trend(t: dict) -> str:
+    lines = [
+        f"doctor trend — {t['rounds_seen']} round(s), window {t['window']}, "
+        f"threshold {t['threshold']:.0%}"
+    ]
+    for key, s in sorted((t.get("series") or {}).items()):
+        if s.get("status") == "insufficient":
+            lines.append(f"  {key:<12} {s['points']} point(s) — insufficient "
+                         "data (need more rounds)")
+            continue
+        lines.append(
+            f"  {key:<12} [{s['status'].upper():<8}] last={s['last']:g} "
+            f"median={s['median']:g} drift/window={s['rel_drift_over_window']:+.1%} "
+            f"last-vs-median={s['last_vs_median']:+.1%}"
+        )
+    if t.get("drifts"):
+        lines.append(f"  SUSTAINED DRIFT in {len(t['drifts'])} metric(s) — "
+                     "the pairwise gate would have missed this")
+    else:
+        lines.append("  no sustained drift")
+    return "\n".join(lines)
+
+
+def run_trend_cli(args) -> int:
+    """``doctor trend [history.jsonl]``: exit 0 = stable/insufficient,
+    1 = sustained drift (the CI gate), 2 = unreadable history."""
+    path = getattr(args, "history", None) or ".bench/history.jsonl"
+    try:
+        with open(path) as f:
+            raw = f.read()
+    except OSError as e:
+        print(f"doctor trend: cannot read history {path!r}: {e}")
+        return 2
+    lines = []
+    for ln in raw.splitlines():
+        ln = ln.strip()
+        if not ln:
+            continue
+        try:
+            lines.append(json.loads(ln))
+        except json.JSONDecodeError:
+            continue  # a torn append must not invalidate the whole history
+    t = analyze_trend(
+        lines,
+        window=getattr(args, "window", 8) or 8,
+        drift_threshold=getattr(args, "drift_threshold", 0.10) or 0.10,
+    )
+    if getattr(args, "format", "text") == "json":
+        print(json.dumps(t, indent=2, sort_keys=True))
+    else:
+        print(format_trend(t))
+    return 1 if t["drifts"] else 0
+
+
+# ---------------------------------------------------------------------------
 # Rendering + CLI
 # ---------------------------------------------------------------------------
 
@@ -458,6 +616,12 @@ def format_diagnosis(diag: dict, regressions: "list | None" = None) -> str:
         lines.append(
             f"  skew {key}: score {s.get('score')} "
             f"(max {s.get('max')} / mean {s.get('mean')}, n={s.get('n')})"
+        )
+    spec = diag.get("speculation")
+    if spec:
+        lines.append(
+            f"  speculation: {spec['won']} won / {spec['wasted']} wasted of "
+            f"{spec['attempts']} attempts (~{spec['time_saved_s']}s saved)"
         )
     st = diag.get("stragglers")
     if st:
@@ -513,9 +677,12 @@ def format_diagnosis(diag: dict, regressions: "list | None" = None) -> str:
 def run_cli(args) -> int:
     """``doctor`` subcommand body. Exit 0 = diagnosis produced; 1 = a
     --baseline watched metric regressed (the CI gate); 2 = unreadable
-    input."""
+    input. The literal target ``trend`` dispatches to the history
+    analyzer (run_trend_cli) instead of the manifest diagnosis."""
     from mapreduce_rust_tpu.runtime.telemetry import load_manifest
 
+    if args.manifest == "trend":
+        return run_trend_cli(args)
     try:
         manifest = load_manifest(args.manifest)
     except (OSError, ValueError, json.JSONDecodeError) as e:

@@ -682,6 +682,108 @@ class PsumReplicatedFlagRule(Rule):
                 break
 
 
+class UnboundedRetryRule(Rule):
+    """Retry/poll loops must back off, bound their attempts, or carry a
+    stop condition.
+
+    Incident: ISSUE 6 piece 3 — the RPC plane's retry loops slept a fixed
+    constant forever: the worker's connect retry hammered a coming-up
+    coordinator at a fixed rate (thundering herd on restart), and a
+    constant-sleep failure loop can busy-hammer a struggling peer while
+    never surfacing the real error. The fix is runtime/backoff.Backoff
+    (jittered exponential, cap, budget); this rule keeps constant-sleep
+    retry loops from coming back.
+
+    Precision: fires only on ``while True`` loops (a real loop condition
+    IS a stop condition) that sleep a non-growing delay — a literal, or a
+    name/attribute never reassigned inside the loop; a delay produced by
+    any call (``backoff.next_delay()``, ``min(...)``) is assumed to grow
+    and stays silent. Two shapes fire: (a) the constant sleep sits on an
+    except-handler retry path with no raise/break/return bounding it
+    anywhere in the loop; (b) the loop has no exit statement at all.
+    Bounded ``for attempt in range(n)`` retries never match (not a While).
+    """
+
+    name = "unbounded-retry"
+    summary = "no constant-sleep retry/poll loops without backoff, cap, or stop condition"
+
+    def run(self, tree, src, path):
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.While):
+                continue
+            if not (isinstance(node.test, ast.Constant)
+                    and node.test.value is True):
+                continue  # the loop test is a stop condition
+            yield from self._check_loop(node, path)
+
+    def _check_loop(self, loop, path):
+        body_nodes = [n for stmt in loop.body for n in ast.walk(stmt)]
+        sleeps = [
+            n for n in body_nodes
+            if isinstance(n, ast.Call)
+            and _last_segment(qualname(n.func)) == "sleep"
+        ]
+        if not sleeps:
+            return
+        assigned: set[str] = set()
+        for n in body_nodes:
+            targets = []
+            if isinstance(n, ast.Assign):
+                targets = n.targets
+            elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+                targets = [n.target]
+            elif isinstance(n, (ast.For, ast.AsyncFor)):
+                targets = [n.target]
+            for t in targets:
+                q = qualname(t)
+                if q:
+                    assigned.add(q)
+
+        def is_constant_delay(call: ast.Call) -> bool:
+            if not call.args:
+                return False
+            arg = call.args[0]
+            if isinstance(arg, ast.Constant):
+                return True
+            if isinstance(arg, (ast.Name, ast.Attribute)):
+                # Never reassigned in the loop → the delay cannot grow.
+                return qualname(arg) not in assigned
+            return False  # computed (a call, arithmetic): assume it grows
+
+        const_sleeps = [c for c in sleeps if is_constant_delay(c)]
+        if not const_sleeps:
+            return
+        has_raise = any(isinstance(n, ast.Raise) for n in body_nodes)
+        for h in (n for n in body_nodes if isinstance(n, ast.ExceptHandler)):
+            h_nodes = list(ast.walk(h))
+            h_sleeps = [c for c in const_sleeps if any(c is n for n in h_nodes)]
+            if not h_sleeps:
+                continue
+            if has_raise or any(
+                isinstance(n, (ast.Break, ast.Return)) for n in h_nodes
+            ):
+                continue  # bounded: attempts surface an error or exit
+            yield self.finding(
+                path, h_sleeps[0],
+                "constant-sleep retry in a `while True` loop — failures "
+                "retry forever at a fixed rate (thundering herd, and the "
+                "real error never surfaces); use runtime/backoff.Backoff "
+                "(jittered exponential with cap and budget) or bound the "
+                "attempts",
+            )
+            return
+        if not any(
+            isinstance(n, (ast.Break, ast.Return, ast.Raise))
+            for n in body_nodes
+        ):
+            yield self.finding(
+                path, const_sleeps[0],
+                "`while True` poll loop sleeping a constant with no exit "
+                "(no break/return/raise) and no backoff — give it a stop "
+                "condition, or draw delays from runtime/backoff.Backoff",
+            )
+
+
 ALL_RULES: list[Rule] = [
     StatsOwnershipRule(),
     ExecutorTeardownRule(),
@@ -692,4 +794,5 @@ ALL_RULES: list[Rule] = [
     SpilledDictApiRule(),
     JitInLoopRule(),
     PsumReplicatedFlagRule(),
+    UnboundedRetryRule(),
 ]

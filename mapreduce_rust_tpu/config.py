@@ -174,6 +174,54 @@ class Config:
                                     # the partial. MR_FLIGHT_RECORD_S
                                     # overrides (test hook).
 
+    # ---- Active fault tolerance (speculation / chaos / degradation) ----
+    speculate: bool = False         # coordinator speculative re-execution:
+                                    # near phase end, re-issue the slowest
+                                    # in-flight task to an idle worker as a
+                                    # NEW attempt — first finish wins, the
+                                    # loser is revoked on its next renewal.
+                                    # The idempotent finish journal keeps
+                                    # outputs bit-identical either way.
+    speculate_after_frac: float = 0.75  # fraction of a phase's tasks done
+                                    # before speculation arms (too early
+                                    # and healthy tasks get duplicated;
+                                    # too late and the straggler tail is
+                                    # already the critical path)
+    speculate_slow_factor: float = 1.5  # once the phase attempt-duration
+                                    # histogram has >= 3 samples, only
+                                    # attempts running longer than this
+                                    # multiple of the task p50 are
+                                    # speculated; before that, any
+                                    # in-flight task is eligible
+    speculate_max_attempts: int = 2  # concurrent attempts per task,
+                                    # original included (2 = at most one
+                                    # speculative copy)
+    chaos: Optional[str] = None     # deterministic fault-injection spec
+                                    # (analysis/chaos.py grammar, e.g.
+                                    # "seed=7;pause:map:0:2.0;kill:reduce:1")
+                                    # — MR_CHAOS in the environment
+                                    # overrides. Faults fire at named
+                                    # worker sites, seeded and
+                                    # reproducible, so every recovery path
+                                    # gets an honest test.
+
+    # ---- RPC-plane degradation (runtime/backoff.py) ----
+    rpc_backoff_base_s: float = 0.05  # first retry delay on a connect
+                                    # failure or transient call timeout
+    rpc_backoff_cap_s: float = 2.0  # delay envelope cap — a worker must
+                                    # not sleep minutes after a blip
+    rpc_backoff_budget_s: float = 60.0  # total retry budget per operation;
+                                    # spent budget surfaces the real error
+                                    # (BackoffExhausted) instead of
+                                    # retrying forever
+    poll_retry_cap_s: Optional[float] = None  # sentinel-poll (-2/-3)
+                                    # backoff cap; None = 4x poll_retry_s.
+                                    # The poll starts at poll_retry_s and
+                                    # backs off — an idle worker stops
+                                    # hammering a long phase gate, but the
+                                    # cap keeps it responsive enough to
+                                    # claim speculative re-executions.
+
     # ---- Paths ----
     input_dir: str = "data"
     input_pattern: str = "*.txt"
@@ -193,6 +241,29 @@ class Config:
             raise ValueError("rpc_timeout_s must be positive")
         if self.flight_record_period_s <= 0:
             raise ValueError("flight_record_period_s must be positive")
+        if not 0.0 < self.speculate_after_frac <= 1.0:
+            raise ValueError("speculate_after_frac must be in (0, 1]")
+        if self.speculate_slow_factor < 1.0:
+            raise ValueError("speculate_slow_factor must be >= 1.0")
+        if self.speculate_max_attempts < 2:
+            raise ValueError(
+                "speculate_max_attempts must be >= 2 (the original plus at "
+                "least one speculative copy)"
+            )
+        if self.rpc_backoff_base_s <= 0 or self.rpc_backoff_cap_s <= 0 \
+                or self.rpc_backoff_budget_s <= 0:
+            raise ValueError("rpc_backoff_* must be positive")
+        if self.poll_retry_cap_s is not None and self.poll_retry_cap_s <= 0:
+            raise ValueError("poll_retry_cap_s must be positive (or None)")
+        if self.chaos:
+            # Fail at config time, not mid-task inside a worker: a typo'd
+            # fault spec must be a loud error before any lease is granted.
+            from mapreduce_rust_tpu.analysis.chaos import ChaosPlan
+
+            ChaosPlan.parse(self.chaos)
+
+    def effective_poll_retry_cap_s(self) -> float:
+        return self.poll_retry_cap_s or 4.0 * self.poll_retry_s
 
     def effective_host_map_workers(self) -> int:
         """Resolved host-map scan worker count: the explicit knob, or

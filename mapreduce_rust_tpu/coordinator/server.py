@@ -41,12 +41,14 @@ import time
 
 from mapreduce_rust_tpu.config import Config
 from mapreduce_rust_tpu.runtime.telemetry import JobReport, write_job_report
+from mapreduce_rust_tpu.runtime.backoff import Backoff, BackoffExhausted
 from mapreduce_rust_tpu.runtime.trace import (
     partial_path,
     per_process_path,
     start_tracing,
     stop_tracing,
     trace_flow,
+    trace_instant,
     trace_span,
 )
 
@@ -105,6 +107,10 @@ class _Phase:
         self.lease_timeout_s = lease_timeout_s
         self.reported: set[int] = set()        # tids with a completion report
         self.last_activity: dict[int, float] = {}  # tid → last grant/renew
+        self.grant_time: dict[int, float] = {}  # tid → ORIGINAL attempt start
+        # (not overwritten by a speculative grant: the speculation picker
+        # and the time-saved estimate both need the older attempt's age)
+        self.spec_live: dict[int, int] = {}     # tid → live speculative copies
 
     def grant(self) -> int:
         """Next task id per the reference grant path (coordinator.rs:137-176):
@@ -123,6 +129,7 @@ class _Phase:
         now = time.monotonic()
         self.leases[tid] = now + self.lease_timeout_s
         self.last_activity[tid] = now
+        self.grant_time[tid] = now
         return tid
 
     def renew(self, tid: int) -> bool:
@@ -139,6 +146,8 @@ class _Phase:
         self.reported.add(tid)
         self.leases.pop(tid, None)
         self.last_activity.pop(tid, None)
+        self.grant_time.pop(tid, None)
+        self.spec_live.pop(tid, None)
         # Finish iff all ids issued, nothing awaiting reassignment, and no
         # lease outstanding (coordinator.rs:252-258).
         if (
@@ -155,6 +164,8 @@ class _Phase:
         for tid in dead:
             del self.leases[tid]
             self.last_activity.pop(tid, None)
+            self.grant_time.pop(tid, None)
+            self.spec_live.pop(tid, None)
             self.assigned[tid] = False  # eligible for re-grant
         return dead
 
@@ -183,6 +194,11 @@ class Coordinator:
         # done(). Aggregate counters only (runtime/metrics.py doctrine).
         self.report = JobReport()
         self._flow_finished: set[str] = set()  # flow ids already terminated
+        self.drained: set[int] = set()  # wids that deregistered gracefully
+        # Live speculation records: (phase, tid) → the original/speculative
+        # attempt pair, kept until first finish (winner decided) or lease
+        # expiry (both attempts dead).
+        self._spec: dict[tuple[str, int], dict] = {}
         self._journal_path = os.path.join(cfg.work_dir, "coordinator.journal")
         if resume:
             self._replay_journal()
@@ -275,6 +291,8 @@ class Coordinator:
 
     def _grant(self, phase: "_Phase", name: str, wid: int = -1) -> int:
         tid = phase.grant()
+        if tid == WAIT and self.cfg.speculate:
+            tid = self._maybe_speculate(phase, name, wid)
         if tid >= 0:
             self.report.record_grant(name, tid, wid=wid)
             # Flow chain start: the grant span forks an arrow the worker's
@@ -286,6 +304,63 @@ class Coordinator:
                 phase=name, tid=tid,
             )
         return tid
+
+    def _maybe_speculate(self, phase: "_Phase", name: str, wid: int) -> int:
+        """Speculative re-execution (ISSUE 6 piece 1): the caller is an
+        IDLE worker (grant() just said WAIT — every task is issued, leases
+        outstanding). Near phase end, re-issue the slowest in-flight task
+        to it as a NEW attempt: first finish wins (the idempotent journal
+        dedups, outputs are atomic-rename so bit-identical either way) and
+        the loser is revoked on its next renewal. Returns a tid or WAIT."""
+        if wid < 0:
+            return WAIT  # anonymous caller: can't prove it isn't the holder
+        done = len(phase.reported)
+        if phase.n == 0 or done / phase.n < self.cfg.speculate_after_frac:
+            return WAIT
+        # Only attempts slower than speculate_slow_factor x the phase task
+        # p50 qualify once the live histogram has signal; before that, any
+        # in-flight task is eligible (the fleet is idle — duplication is
+        # the cheap side of the trade, per Coded TeraSort).
+        p50 = self.report.phase_task_p50(name, min_count=3)
+        now = time.monotonic()
+        best_tid, best_age = None, -1.0
+        for tid in phase.leases:
+            holder = self._tasks_wid(name, tid)
+            if holder is None or holder == wid:
+                continue  # unknown holder, or the caller already runs it
+            if 1 + phase.spec_live.get(tid, 0) >= self.cfg.speculate_max_attempts:
+                continue
+            age = now - phase.grant_time.get(tid, now)
+            if p50 is not None and age <= self.cfg.speculate_slow_factor * p50:
+                continue
+            if age > best_age:
+                best_tid, best_age = tid, age
+        if best_tid is None:
+            return WAIT
+        orig_attempt = self.report.attempts(name, best_tid)
+        phase.spec_live[best_tid] = phase.spec_live.get(best_tid, 0) + 1
+        # Extend the (shared) lease: both attempts renew the same entry, so
+        # the detector only fires once BOTH are dead.
+        phase.leases[best_tid] = now + phase.lease_timeout_s
+        phase.last_activity[best_tid] = now
+        self._spec[(name, best_tid)] = {
+            "orig_attempt": orig_attempt,
+            "orig_age_s": best_age,
+            "spec_attempt": orig_attempt + 1,
+            "spec_start": now,
+            "spec_wid": wid,
+        }
+        self.report.record_speculation(name, best_tid, wid=wid)
+        trace_instant("coordinator.speculate", phase=name, tid=best_tid,
+                      attempt=orig_attempt + 1, wid=wid)
+        log.info(
+            "speculating %s %d (attempt %d, original running %.2fs) to "
+            "worker %d", name, best_tid, orig_attempt + 1, best_age, wid,
+        )
+        return best_tid
+
+    def _tasks_wid(self, name: str, tid: int) -> "int | None":
+        return self.report.task_wid(name, tid)
 
     # ``wid`` on the task RPCs (ISSUE 5 satellite, the PR 4 ROADMAP
     # leftover): grants/renewals/finishes attribute per WORKER as well as
@@ -321,7 +396,36 @@ class Coordinator:
         # double-journal and double-count — now it lands as a distinct
         # late_reports stat and journals exactly once (ISSUE 4 satellite).
         first = tid not in phase.reported
+        # Speculation race settled: the FIRST report of a speculated task
+        # decides won vs wasted. Read the shared lease deadline BEFORE
+        # report_finish pops it — the time-saved estimate is against the
+        # lease-expiry-only recovery the reference has (the loser's lease
+        # would still have had to run out before a re-grant even started).
+        lease_remaining = max(phase.leases.get(tid, 0.0) - time.monotonic(), 0.0)
         done = phase.report_finish(tid)
+        if first:
+            spec = self._spec.pop((name, tid), None)
+            if spec is not None:
+                now = time.monotonic()
+                # The reporter's own attempt number decides the race. An
+                # attempt-less report (0: pre-attempt client / default
+                # caller) is UNATTRIBUTABLE — falling back to attempts()
+                # would equal spec_attempt (the speculative grant already
+                # bumped it) and score an original's finish as a win with
+                # a fabricated time saved. Unknown ⇒ score conservatively
+                # as the original winning (wasted).
+                won = attempt >= spec["spec_attempt"]
+                saved = (
+                    lease_remaining + (now - spec["spec_start"]) if won else 0.0
+                )
+                self.report.record_speculation_result(
+                    name, won=won, time_saved_s=saved
+                )
+                log.info(
+                    "%s %d speculation %s (attempt %d reported first%s)",
+                    name, tid, "won" if won else "wasted", attempt,
+                    f", ~{saved:.2f}s saved vs lease expiry" if won else "",
+                )
         self.report.record_finish(name, tid, late=not first, wid=wid)
         fid = f"{name}:{tid}:{attempt or self.report.attempts(name, tid)}"
         if fid not in self._flow_finished:
@@ -345,6 +449,18 @@ class Coordinator:
         done = self._finish(self.reduce, "reduce", tid, attempt, wid)
         log.info("reduce %d finished (job done=%s)", tid, done)
         return done
+
+    def deregister_worker(self, wid: int = -1) -> bool:
+        """Graceful drain (ISSUE 6 piece 3): a SIGTERM'd worker finishes
+        its current task, reports it, then calls this — so `watch` and
+        `progress` show it as DRAINED, not as a crash the lease detector
+        will eventually notice. Holds no scheduler state: a drained
+        worker's tasks were already reported (it drains between tasks)."""
+        if not isinstance(wid, int) or wid < 0 or wid >= self.worker_count:
+            return False
+        self.drained.add(wid)
+        log.info("worker %d deregistered (graceful drain)", wid)
+        return True
 
     def stats(self) -> dict:
         """The 8th RPC: the live control-plane job report — task states,
@@ -394,6 +510,11 @@ class Coordinator:
             "workers": {
                 "registered": self.worker_count,
                 "expected": self.cfg.worker_n,
+                # Drained ≠ crashed: these wids deregistered gracefully
+                # (SIGTERM drain); a crashed worker instead shows up as a
+                # STALE lease above until the detector expires it.
+                "drained": sorted(self.drained),
+                "active": self.worker_count - len(self.drained),
                 # Per-worker detail lives ONCE in the response: the stats
                 # RPC's top-level "workers" block (JobReport.to_dict) —
                 # what `watch` renders as the worker column. Duplicating
@@ -415,6 +536,11 @@ class Coordinator:
         phase, name = (self.reduce, "reduce") if self.map.finished else (self.map, "map")
         for tid in phase.expire_stale():
             self.report.record_expiry(name, tid)
+            if self._spec.pop((name, tid), None) is not None:
+                # The shared lease ran out: BOTH the original and its
+                # speculative copy went silent — the speculation bought
+                # nothing and the normal expiry path re-grants from scratch.
+                self.report.record_speculation_result(name, won=False)
             log.warning("%s task %d lease expired — rescheduling", name, tid)
 
     # ---- transport ----
@@ -423,7 +549,7 @@ class Coordinator:
         "get_worker_id", "get_map_task", "get_reduce_task",
         "renew_map_lease", "renew_reduce_lease",
         "report_map_task_finish", "report_reduce_task_finish",
-        "stats",
+        "deregister_worker", "stats",
     })
 
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
@@ -464,6 +590,21 @@ class Coordinator:
                         # flow chain (still just small integers).
                         phase = "map" if method == "get_map_task" else "reduce"
                         resp["attempt"] = self.report.attempts(phase, result)
+                    elif (
+                        method in ("renew_map_lease", "renew_reduce_lease")
+                        and result is False
+                    ):
+                        # A failed renewal is one of two very different
+                        # things, and the envelope says which: REVOKED —
+                        # the task already completed (another attempt won
+                        # the race); stop computing, never report. Not
+                        # revoked — the lease merely expired but the task
+                        # is still wanted; keep computing, a late report
+                        # is a genuine completion that may still win.
+                        ph = self.map if method == "renew_map_lease" \
+                            else self.reduce
+                        params = req.get("params") or [None]
+                        resp["revoked"] = params[0] in ph.reported
                 writer.write(json.dumps(resp).encode() + b"\n")
                 await writer.drain()
         except (ConnectionResetError, asyncio.IncompleteReadError, json.JSONDecodeError):
@@ -555,11 +696,25 @@ class CoordinatorClient:
         self.timeout_s = timeout_s
         self.sync = sync
         self.last_attempt = 0  # attempt number of the last task grant
+        self.last_revoked = False  # the last failed renewal was a revocation
         self._reader: asyncio.StreamReader | None = None
         self._writer: asyncio.StreamWriter | None = None
         self._next_id = 0
 
-    async def connect(self, retries: int = 50, delay: float = 0.1) -> None:
+    async def connect(self, retries: int = 50, delay: float = 0.1,
+                      budget_s: "float | None" = None) -> None:
+        """Connect with jittered exponential backoff between attempts
+        (``delay`` is the BASE delay now, not the fixed one): bounded by
+        both the attempt count and a total-sleep ``budget_s`` — a fleet
+        restarting against a coming-up coordinator spreads out instead of
+        arriving in lockstep. ``budget_s`` defaults to ``retries * delay``,
+        the fixed-delay era's total wait, so a dead coordinator still
+        surfaces its ConnectionError on the old clock (~5 s at the
+        defaults) rather than after the full grown-delay sum."""
+        if budget_s is None:
+            budget_s = retries * delay
+        backoff = Backoff(base_s=delay, cap_s=max(2.0, delay),
+                          budget_s=budget_s)
         for attempt in range(retries):
             try:
                 coro = asyncio.open_connection(self.host, self.port)
@@ -573,11 +728,17 @@ class CoordinatorClient:
                         f"connect to coordinator {self.host}:{self.port} "
                         f"timed out after {self.timeout_s}s"
                     ) from None
-                await asyncio.sleep(delay)
             except OSError:
                 if attempt == retries - 1:
                     raise
-                await asyncio.sleep(delay)
+            try:
+                await asyncio.sleep(backoff.next_delay())
+            except BackoffExhausted:
+                raise ConnectionError(
+                    f"connect to coordinator {self.host}:{self.port}: retry "
+                    f"budget ({budget_s}s) exhausted after "
+                    f"{attempt + 1} attempts"
+                ) from None
 
     async def call(self, method: str, *params) -> int | bool:
         assert self._writer is not None, "connect() first"
@@ -612,6 +773,7 @@ class CoordinatorClient:
             self.sync.add(now - (t0 + t1) / 2, t1 - t0)
         if "attempt" in resp:
             self.last_attempt = int(resp["attempt"])
+        self.last_revoked = bool(resp.get("revoked", False))
         return resp["result"]
 
     async def close(self) -> None:

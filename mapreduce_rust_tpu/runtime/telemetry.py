@@ -57,6 +57,11 @@ class JobReport:
         # worker's p50 against the fleet median.
         self._workers: dict[int, dict] = {}
         self._phase_hist: dict[str, Histogram] = {}  # attempt durations
+        # Speculation accounting (ISSUE 6): per-phase attempts issued, the
+        # won/wasted split once races settle, and the estimated time saved
+        # vs the lease-expiry-only recovery — the doctor's
+        # speculation-effectiveness input.
+        self._speculation: dict[str, dict] = {}
         self._t0 = time.monotonic()
 
     def _task(self, phase: str, tid: int) -> dict:
@@ -64,6 +69,7 @@ class JobReport:
         if t is None:
             t = self._tasks[(phase, tid)] = {
                 "grants": 0,
+                "speculations": 0,
                 "renewals": 0,
                 "stale_renewals": 0,
                 "expiries": 0,
@@ -96,6 +102,45 @@ class JobReport:
         number of the CURRENT grant, and the suffix of its flow id."""
         t = self._tasks.get((phase, tid))
         return t["grants"] if t is not None else 0
+
+    def task_wid(self, phase: str, tid: int) -> "int | None":
+        """The worker id of the task's most recent grant (None when the
+        grant was anonymous) — the speculation picker's don't-speculate-
+        to-the-holder check."""
+        t = self._tasks.get((phase, tid))
+        return t["wid"] if t is not None else None
+
+    def phase_task_p50(self, phase: str, min_count: int = 1) -> "float | None":
+        """The live attempt-duration median of a phase, or None until the
+        histogram holds at least ``min_count`` samples — the speculation
+        picker's slowness yardstick."""
+        h = self._phase_hist.get(phase)
+        if h is None or h.count < min_count:
+            return None
+        return h.percentile(0.5)
+
+    def record_speculation(self, phase: str, tid: int, wid=None) -> None:
+        """Mark the NEXT grant of (phase, tid) as speculative. The grant
+        itself still goes through record_grant — a speculative grant IS a
+        grant (the attempt number bumps, the flow chain forks); this only
+        adds the speculation accounting on top."""
+        self._task(phase, tid)["speculations"] += 1
+        self._spec_phase(phase)["attempts"] += 1
+
+    def record_speculation_result(self, phase: str, won: bool,
+                                  time_saved_s: float = 0.0) -> None:
+        s = self._spec_phase(phase)
+        s["won" if won else "wasted"] += 1
+        if won:
+            s["time_saved_s"] += max(time_saved_s, 0.0)
+
+    def _spec_phase(self, phase: str) -> dict:
+        s = self._speculation.get(phase)
+        if s is None:
+            s = self._speculation[phase] = {
+                "attempts": 0, "won": 0, "wasted": 0, "time_saved_s": 0.0,
+            }
+        return s
 
     def phase_expiries(self, phase: str) -> int:
         return sum(
@@ -217,6 +262,7 @@ class JobReport:
             phases.setdefault(phase, {})[str(tid)] = {
                 "grants": t["grants"],
                 "re_executions": max(t["grants"] - 1, 0),
+                "speculations": t["speculations"],
                 "expiries": t["expiries"],
                 "renewals": t["renewals"],
                 "stale_renewals": t["stale_renewals"],
@@ -241,6 +287,14 @@ class JobReport:
                 # Attempt-duration distribution (seconds): the doctor's
                 # lease-tuning input (expiries vs task p99).
                 totals[phase]["task_s"] = h.to_dict()
+        for phase, s in self._speculation.items():
+            if phase in totals:
+                totals[phase]["speculation"] = {
+                    "attempts": s["attempts"],
+                    "won": s["won"],
+                    "wasted": s["wasted"],
+                    "time_saved_s": round(s["time_saved_s"], 6),
+                }
         rpc = {
             m: {
                 # Keys preserved from the aggregate-counter era (count /
@@ -281,13 +335,20 @@ def format_progress(stats: dict) -> str:
     a pre-progress coordinator (totals only)."""
     prog = stats.get("progress") or {}
     workers = prog.get("workers") or {}
+    drained = workers.get("drained") or []
     lines = [
         f"coordinator: phase {prog.get('phase', '?')}"
         f" · workers {workers.get('registered', '?')}/{workers.get('expected', '?')}"
-        f" · up {prog.get('uptime_s', 0.0):.1f}s"
+        + (
+            f" ({len(drained)} drained: "
+            + ", ".join(f"w{w}" for w in drained) + ")"
+            if drained else ""
+        )
+        + f" · up {prog.get('uptime_s', 0.0):.1f}s"
     ]
     totals = stats.get("totals") or {}
     for name in ("map", "reduce"):
+        spec = (totals.get(name) or {}).get("speculation")
         ph = (prog.get("phases") or {}).get(name)
         if ph is None:
             tot = totals.get(name)
@@ -305,6 +366,11 @@ def format_progress(stats: dict) -> str:
             f"  {name:<7} [{bar}] {done}/{n} done · "
             f"{ph['in_flight']} in-flight · {ph['pending']} pending · "
             f"{ph['expired']} expired · {ph['late_reports']} late"
+            + (
+                f" · spec {spec['won']}w/{spec['wasted']}x"
+                f"/{spec['attempts']}a"
+                if spec and spec.get("attempts") else ""
+            )
         )
         for tid, lease in sorted(
             (ph.get("leases") or {}).items(), key=lambda kv: int(kv[0])

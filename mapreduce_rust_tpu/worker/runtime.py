@@ -33,11 +33,16 @@ import collections
 import logging
 import os
 import pathlib
+import signal as _signal
+import sys
+import threading
 import time
+import types
 import uuid
 
 import numpy as np
 
+from mapreduce_rust_tpu.analysis.chaos import ChaosPlan
 from mapreduce_rust_tpu.apps import get_app
 from mapreduce_rust_tpu.apps.base import App
 from mapreduce_rust_tpu.config import Config
@@ -50,6 +55,7 @@ from mapreduce_rust_tpu.coordinator.server import (
     RpcTimeout,
 )
 from mapreduce_rust_tpu.core.hashing import hash_words
+from mapreduce_rust_tpu.runtime.backoff import Backoff, BackoffExhausted
 from mapreduce_rust_tpu.runtime.chunker import chunk_stream, list_inputs
 from mapreduce_rust_tpu.runtime.dictionary import Dictionary, extract_words
 from mapreduce_rust_tpu.runtime.telemetry import JobReport
@@ -106,12 +112,70 @@ class Worker:
         # the manifest and trace metadata for `trace merge`.
         self.sync = ClockSync()
         self._attempts: dict[tuple[str, int], int] = {}  # (phase, tid) → n
+        # Deterministic fault injection (analysis/chaos.py): None unless
+        # Config.chaos / MR_CHAOS carries a spec. Every site below calls
+        # self._chaos(...) — one checkpoint, one trigger log.
+        self.chaos = ChaosPlan.from_config(cfg)
+        # Graceful drain (SIGTERM): set (thread-safely — signal handlers
+        # and executor threads both touch it) to finish the current task,
+        # report it, deregister, and exit cleanly between tasks.
+        self._drain = threading.Event()
+        self.drained = False
+        # Tasks whose lease was REVOKED mid-compute (a speculative race
+        # lost): their finish report is skipped — the winner already
+        # journaled — and the manifest lists them.
+        self.revoked_tasks: list[str] = []
+        # Device-memory high-water shim for _sample_device_memory (the
+        # worker has a JobReport, not a JobStats): worker manifests carry
+        # device.mem.d* gauges + device_mem_high_bytes too (PR 5 leftover).
+        self._mem = types.SimpleNamespace(device_mem_high_bytes=0)
 
     @property
     def _wid(self) -> int:
         """Worker id for RPC attribution (-1 = not yet registered: the
         coordinator treats it as anonymous, never a phantom worker row)."""
         return self.worker_id if self.worker_id is not None else -1
+
+    def request_drain(self) -> None:
+        """Graceful drain: finish the current task, report it, deregister,
+        exit 0. Thread- and signal-safe (a threading.Event, checked at
+        task boundaries — never mid-compute). The CLI wires SIGTERM here."""
+        self._drain.set()
+
+    def _chaos_pick(self, site: str, **ctx):
+        """The single chaos checkpoint: returns the matching Fault (or
+        None), logging + tracing every trigger so the injected fault is
+        visible in the timeline next to its recovery."""
+        if self.chaos is None:
+            return None
+        f = self.chaos.pick(site, **ctx)
+        if f is not None:
+            trace_instant(f"chaos.{site}",
+                          **{k: v for k, v in ctx.items() if v is not None})
+            log.warning("chaos: injecting %s (%s)", site, ctx)
+        return f
+
+    def _sample_memory(self) -> None:
+        """Device-memory gauge from the worker task loop (PR 5 leftover):
+        only when a backend is ALREADY INITIALIZED in this process (the
+        device engine's first task does that). Merely-imported jax is not
+        enough — jax.local_devices() on an uninitialized process would
+        TRIGGER backend init, and against an absent accelerator that is a
+        ~minutes-long metadata probe; a telemetry gauge must never be the
+        thing that wedges a worker."""
+        jax = sys.modules.get("jax")
+        if jax is None:
+            return
+        try:
+            from jax._src import xla_bridge
+
+            if not xla_bridge._backends:
+                return
+        except Exception:
+            return  # unknown jax layout: skip the gauge, never the task
+        from mapreduce_rust_tpu.runtime.driver import _sample_device_memory
+
+        _sample_device_memory(self._mem)
 
     # ---- map/reduce engines ----
 
@@ -194,6 +258,31 @@ class Worker:
                        dictionary, doc_id_offset=doc_id)
         return acc.table, dictionary
 
+    def _chaos_task_entry(self, phase: str, tid: int, att: int) -> None:
+        """Injection sites at task entry (runs on the executor thread, so
+        sleeps here never starve the event loop or the renewal heartbeat):
+        ``slow_scan`` — this worker computes N s slower per task (the
+        heterogeneous-fleet straggler); ``kill`` — SIGKILL mid-task, lease
+        held, nothing reported (the crash the lease detector exists for)."""
+        f = self._chaos_pick("slow_scan", phase=phase, tid=tid, attempt=att,
+                             wid=self._wid)
+        if f is not None:
+            time.sleep(f.seconds)
+        f = self._chaos_pick("kill", phase=phase, tid=tid, attempt=att,
+                             wid=self._wid)
+        if f is not None:
+            maybe_snapshot()  # the flight recorder keeps what we had
+            os.kill(os.getpid(), _signal.SIGKILL)
+
+    def _chaos_before_finish(self, phase: str, tid: int, att: int) -> None:
+        """``pause`` site: sleep before the task returns — the task is
+        DONE computing but holds its lease, renewals keep flowing. The
+        slow-but-alive straggler only speculation (or patience) beats."""
+        f = self._chaos_pick("pause", phase=phase, tid=tid, attempt=att,
+                             wid=self._wid)
+        if f is not None:
+            time.sleep(f.seconds)
+
     def run_map_task(self, tid: int) -> None:
         att = self._attempts.get(("map", tid), 1)
         with trace_span("worker.map_task", tid=tid, attempt=att):
@@ -203,7 +292,9 @@ class Worker:
             # at task exit (a SIGKILLed attempt leaves the begin mark).
             trace_flow("task", "t", f"map:{tid}:{att}", phase="map", tid=tid)
             trace_instant("worker.task_begin", phase="map", tid=tid, attempt=att)
+            self._chaos_task_entry("map", tid, att)
             self._run_map_task(tid)
+            self._chaos_before_finish("map", tid, att)
 
     def _run_map_task(self, tid: int) -> None:
         path = self.inputs[tid]
@@ -245,7 +336,9 @@ class Worker:
         with trace_span("worker.reduce_task", tid=tid, attempt=att):
             trace_flow("task", "t", f"reduce:{tid}:{att}", phase="reduce", tid=tid)
             trace_instant("worker.task_begin", phase="reduce", tid=tid, attempt=att)
+            self._chaos_task_entry("reduce", tid, att)
             self._run_reduce_task(tid)
+            self._chaos_before_finish("reduce", tid, att)
 
     def _run_reduce_task(self, tid: int) -> None:
         from mapreduce_rust_tpu.analysis.sanitize import new_dictionary
@@ -290,7 +383,8 @@ class Worker:
         return "map" if "map" in method else "reduce"
 
     async def _renewal_loop(self, client: CoordinatorClient, method: str,
-                            tid: int, stop: asyncio.Event) -> None:
+                            tid: int, stop: asyncio.Event,
+                            revoked: "asyncio.Event | None" = None) -> None:
         # ``stop`` backs up task cancellation: on Python < 3.12,
         # asyncio.wait_for SWALLOWS a cancel that lands just as its inner
         # future completes (bpo-42130) — with the per-call rpc timeout
@@ -299,7 +393,23 @@ class Worker:
         # lease would never expire, and the task's finish report would
         # never be sent: a distributed deadlock. The flag makes the exit
         # condition level-triggered instead of edge-triggered.
+        #
+        # ``revoked`` is the speculation-loser signal (ISSUE 6): a failed
+        # renewal whose envelope says revoked=True means another attempt
+        # already COMPLETED this task — set the event so the task loop
+        # skips the finish report (the winner journaled; ours would only
+        # land as a late report of work nobody needs).
+        phase = self._phase_name(method)
+        att = self._attempts.get((phase, tid), 1)
         try:
+            if self._chaos_pick("wedge_renewal", phase=phase, tid=tid,
+                                attempt=att, wid=self._wid) is not None:
+                # Wedged heartbeat thread: the task keeps computing but no
+                # renewal ever goes out — the lease expires under a LIVE
+                # task and our eventual report lands late. stop.wait()
+                # (not a sleep loop) so teardown stays immediate.
+                await stop.wait()
+                return
             while not stop.is_set():
                 await asyncio.sleep(self.cfg.lease_renew_period_s)
                 if stop.is_set():
@@ -307,15 +417,17 @@ class Worker:
                 ok = await self._call(client, method, tid, self._wid)
                 if stop.is_set():
                     return  # a swallowed cancel still exits here
-                self.report.record_renewal(
-                    self._phase_name(method), tid, bool(ok), wid=self._wid
-                )
+                self.report.record_renewal(phase, tid, bool(ok), wid=self._wid)
                 # Snapshot AFTER the renewal is on the wire: under GIL
                 # contention with the compute thread the snapshot's IO can
                 # take 100s of ms, and the heartbeat must never queue
                 # behind telemetry (a delayed renewal is a lease expiry).
                 maybe_snapshot()
                 if not ok:
+                    if revoked is not None and client.last_revoked:
+                        revoked.set()
+                        log.info("%s %d attempt %d revoked — another "
+                                 "attempt won", phase, tid, att)
                     return  # stale lease (already reported) — just stop
         except (asyncio.CancelledError, ConnectionResetError):
             pass
@@ -324,36 +436,87 @@ class Worker:
             # server-side (if the coordinator ever recovers) and our own
             # eventual finish report lands as a late_report. The task
             # itself keeps computing; only the heartbeat is dead.
-            log.warning("renewal loop for %s %d stopped: %s",
-                        self._phase_name(method), tid, e)
+            log.warning("renewal loop for %s %d stopped: %s", phase, tid, e)
+
+    async def _call_with_retry(self, client: CoordinatorClient, method: str,
+                               *params):
+        """Task-loop RPC with transient-failure hardening: an RpcTimeout
+        (wedged or momentarily stalled coordinator) retries on a fresh
+        connection under jittered exponential backoff with a budget —
+        then surfaces the timeout. ConnectionError is NOT retried: a
+        vanished coordinator means the job completed (the caller's
+        long-standing heuristic), and retrying it for a full budget would
+        turn every clean shutdown into a minute-long stall. Safe to
+        retry: grants self-heal via lease expiry, finish reports are
+        idempotent per (phase, tid)."""
+        backoff = Backoff(
+            self.cfg.rpc_backoff_base_s, self.cfg.rpc_backoff_cap_s,
+            budget_s=self.cfg.rpc_backoff_budget_s,
+        )
+        while True:
+            try:
+                return await self._call(client, method, *params)
+            except RpcTimeout as e:
+                try:
+                    delay = backoff.next_delay()
+                except BackoffExhausted:
+                    raise e from None
+                log.warning("%s: %s — retrying in %.2fs (attempt %d)",
+                            method, e, delay, backoff.attempts)
+                await asyncio.sleep(delay)
+                # The old connection is poisoned (a late response would
+                # desync request ids): reconnect before the retry. A
+                # refused reconnect = coordinator genuinely gone — the
+                # ConnectionError propagates to the caller's done path.
+                await client.close()
+                await client.connect(
+                    budget_s=self.cfg.rpc_backoff_budget_s
+                )
 
     async def _run_phase(self, client: CoordinatorClient, get: str, renew: str,
-                         report: str, run_task) -> None:
+                         report: str, run_task) -> bool:
+        """One phase of the pull loop. Returns True when the worker exited
+        because a DRAIN was requested (the caller then deregisters and
+        skips any remaining phase) — False on normal phase completion."""
         phase = self._phase_name(get)
+        # Sentinel (-2/-3) polling backs off exponentially from
+        # poll_retry_s up to the cap instead of hammering at a fixed rate;
+        # a real grant resets the envelope.
+        poll = Backoff(
+            base_s=self.cfg.poll_retry_s,
+            cap_s=self.cfg.effective_poll_retry_cap_s(),
+            jitter=0.25,
+        )
         while True:
+            if self._drain.is_set():
+                return True  # between tasks: nothing held, nothing owed
             try:
                 # The worker id rides on every task RPC so the coordinator
                 # attributes grants/renewals/finishes per worker (the
                 # `watch` worker column + doctor straggler input).
-                tid = await self._call(client, get, self._wid)
+                tid = await self._call_with_retry(client, get, self._wid)
             except ConnectionError:
                 # Coordinator exited between our WAIT poll and this call —
                 # the job completed while we slept. A clean end, not a crash.
                 # (ConnectionError only: other OSErrors — fd exhaustion,
                 # network flaps — must surface, not fake success. An
-                # RpcTimeout — wedged, not gone — propagates too.)
+                # RpcTimeout — wedged, not gone — propagates once the
+                # retry budget is spent.)
                 log.info("coordinator gone — assuming job complete")
-                return
+                return False
             if tid == DONE:
-                return
+                return False
             if tid in (NOT_READY, WAIT):
                 maybe_snapshot()
-                await asyncio.sleep(self.cfg.poll_retry_s)
+                self._sample_memory()
+                await asyncio.sleep(poll.next_delay())
                 continue
+            poll.reset()
             self.report.record_grant(phase, tid, wid=self._wid)
             # The grant response carried the coordinator's attempt number:
             # the task span joins that attempt's flow chain.
-            self._attempts[(phase, tid)] = client.last_attempt or 1
+            att = client.last_attempt or 1
+            self._attempts[(phase, tid)] = att
             # Separate connection for renewals, like the reference's
             # spawned renewal task (mrworker.rs:70-94) — but paced.
             renew_client = CoordinatorClient(
@@ -362,8 +525,10 @@ class Worker:
             )
             await renew_client.connect()
             stop_renewal = asyncio.Event()
+            revoked = asyncio.Event()
             renewal = asyncio.create_task(
-                self._renewal_loop(renew_client, renew, tid, stop_renewal)
+                self._renewal_loop(renew_client, renew, tid, stop_renewal,
+                                   revoked)
             )
             try:
                 # Heavy compute off the event loop so renewals keep flowing.
@@ -375,8 +540,48 @@ class Worker:
                 renewal.cancel()
                 await asyncio.gather(renewal, return_exceptions=True)
                 await renew_client.close()
-            await self._call(client, report, tid,
-                             self._attempts.get((phase, tid), 0), self._wid)
+            self._sample_memory()
+            if revoked.is_set():
+                # Speculation loser: another attempt already completed and
+                # journaled this task. Terminate OUR flow chain (the lost
+                # race stays visible in the merged timeline) and never
+                # send the finish report — the coordinator-side journal
+                # must hold exactly one line per task.
+                trace_flow("task", "f", f"{phase}:{tid}:{att}",
+                           phase=phase, tid=tid, revoked=True)
+                self.revoked_tasks.append(f"{phase}:{tid}:{att}")
+                log.info("%s %d: dropping finish report (revoked)", phase, tid)
+                maybe_snapshot()
+                continue
+            f = self._chaos_pick("delay_finish", phase=phase, tid=tid,
+                                 attempt=att, wid=self._wid)
+            if f is not None:
+                await asyncio.sleep(f.seconds)
+            if self._chaos_pick("drop_finish", phase=phase, tid=tid,
+                                attempt=att, wid=self._wid) is not None:
+                # The report never leaves this worker: the coordinator
+                # sees only silence, the lease expires, the task re-runs
+                # (atomic spill rewrites keep the rerun bit-identical).
+                log.warning("%s %d: finish report dropped (chaos)", phase, tid)
+            else:
+                try:
+                    await self._call_with_retry(
+                        client, report, tid,
+                        self._attempts.get((phase, tid), 0), self._wid,
+                    )
+                except ConnectionError:
+                    # The coordinator exited while we computed: under
+                    # speculation a revoked loser can outlive the whole
+                    # JOB (another attempt won, every phase closed, the
+                    # coordinator left before our renewal could observe
+                    # the revocation). Our result is unneeded — terminate
+                    # the chain as revoked and end like the poll path.
+                    trace_flow("task", "f", f"{phase}:{tid}:{att}",
+                               phase=phase, tid=tid, revoked=True)
+                    self.revoked_tasks.append(f"{phase}:{tid}:{att}")
+                    log.info("%s %d: coordinator gone before finish report "
+                             "— job complete, dropping it", phase, tid)
+                    return False
             self.report.record_finish(phase, tid, wid=self._wid)
             maybe_snapshot()
 
@@ -404,27 +609,56 @@ class Worker:
                 return
             self.worker_id = wid
             log.info("worker %d: map phase", wid)
-            await self._run_phase(client, "get_map_task", "renew_map_lease",
-                                  "report_map_task_finish", self.run_map_task)
-            log.info("worker %d: reduce phase", wid)
-            await self._run_phase(client, "get_reduce_task", "renew_reduce_lease",
-                                  "report_reduce_task_finish", self.run_reduce_task)
-            log.info("worker %d: done (%s)", wid, self.report.summary())
+            draining = await self._run_phase(
+                client, "get_map_task", "renew_map_lease",
+                "report_map_task_finish", self.run_map_task)
+            if not draining:
+                log.info("worker %d: reduce phase", wid)
+                draining = await self._run_phase(
+                    client, "get_reduce_task", "renew_reduce_lease",
+                    "report_reduce_task_finish", self.run_reduce_task)
+            if draining:
+                # Graceful drain: the current task is finished and
+                # reported; deregister so watch/progress show DRAINED
+                # instead of a silence the lease detector must diagnose.
+                self.drained = True
+                try:
+                    await self._call(client, "deregister_worker", self._wid)
+                except (ConnectionError, RpcTimeout):
+                    pass  # coordinator gone/wedged: drain proceeds anyway
+                log.info("worker %d: drained (%s)", wid, self.report.summary())
+            else:
+                log.info("worker %d: done (%s)", wid, self.report.summary())
         finally:
             await client.close()
             if tracer is not None:
                 stop_tracing()
             from mapreduce_rust_tpu.runtime.telemetry import flush_run_artifacts
 
+            extra = {
+                "kind": "worker_manifest",
+                "worker_id": self.worker_id,
+                "engine": self.engine,
+                "report": self.report.to_dict(),
+                # NTP-style offset to the coordinator clock (offset ±
+                # RTT/2): the stitcher's cross-process rebase evidence.
+                "clock_sync": self.sync.best(),
+                "drained": self.drained,
+                # Worker-loop device-memory high water (PR 5 leftover; 0 on
+                # backends without memory_stats or when jax never loaded).
+                "device_mem_high_bytes": self._mem.device_mem_high_bytes,
+            }
+            if self.revoked_tasks:
+                extra["revoked_tasks"] = self.revoked_tasks
+            if self.chaos is not None:
+                # The honest record of which injected faults actually
+                # fired (a SIGKILLed worker can't write this — its faults
+                # are visible as the crash itself).
+                extra["chaos"] = {
+                    "spec": self.chaos.spec,
+                    "fired": self.chaos.fired(),
+                }
             flush_run_artifacts(
                 self.cfg, tracer, tag=f"w{os.getpid()}", logger=log,
-                extra={
-                    "kind": "worker_manifest",
-                    "worker_id": self.worker_id,
-                    "engine": self.engine,
-                    "report": self.report.to_dict(),
-                    # NTP-style offset to the coordinator clock (offset ±
-                    # RTT/2): the stitcher's cross-process rebase evidence.
-                    "clock_sync": self.sync.best(),
-                },
+                extra=extra,
             )

@@ -37,3 +37,12 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402  (import order is the point)
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    # Tier-1 runs `-m 'not slow'`: the slow marker carries the full chaos
+    # matrix (every seeded fault scenario as OS processes, trace-merged);
+    # the seeded smoke scenario stays in the default selection.
+    config.addinivalue_line(
+        "markers", "slow: long-running scenario suites excluded from tier-1"
+    )

@@ -751,6 +751,337 @@ def test_cli_coordinator_worker_subprocesses(tmp_path):
     assert read_outputs(cfg) == oracle()
 
 
+# ---- speculation, revocation, drain, backoff (ISSUE 6) ----
+
+def test_speculation_grants_slowest_inflight_near_phase_end(tmp_path):
+    cfg = make_cfg(tmp_path, 2, worker_n=2, speculate=True,
+                   speculate_after_frac=0.5)
+    c = Coordinator(cfg)
+    c.get_worker_id()
+    c.get_worker_id()
+    assert c.get_map_task(0) == 0
+    assert c.get_map_task(1) == 1
+    # Below the arm fraction: the idle worker just waits.
+    assert c.get_map_task(1) == WAIT
+    c.report_map_task_finish(1, 1, 1)   # 1/2 done = the arm fraction
+    # Now the idle worker's poll turns into a speculative attempt 2 …
+    assert c.get_map_task(1) == 0
+    assert c.report.attempts("map", 0) == 2
+    # … capped at speculate_max_attempts (2): no third copy.
+    assert c.get_map_task(1) == WAIT
+    # First finish wins (the speculative attempt), the race is accounted.
+    assert c.report_map_task_finish(0, 2, 1)
+    spec = c.stats()["totals"]["map"]["speculation"]
+    assert spec["attempts"] == 1 and spec["won"] == 1
+    assert spec["wasted"] == 0 and spec["time_saved_s"] > 0
+    assert c.stats()["tasks"]["map"]["0"]["speculations"] == 1
+    # The loser's renewal degrades to False — and the task IS reported,
+    # which is what the RPC envelope surfaces to the worker as revoked.
+    assert c.renew_map_lease(0, 0) is False
+    assert 0 in c.map.reported
+    # Exactly one journal line for the raced task.
+    journal = pathlib.Path(cfg.work_dir) / "coordinator.journal"
+    assert journal.read_text().splitlines().count("map 0") == 1
+
+
+def test_speculation_never_duplicates_to_the_holder(tmp_path):
+    # The worker already running the task must not be handed a second
+    # copy of it — and anonymous (wid-less) pollers get none at all.
+    cfg = make_cfg(tmp_path, 2, worker_n=1, speculate=True,
+                   speculate_after_frac=0.5)
+    c = Coordinator(cfg)
+    c.get_worker_id()
+    assert c.get_map_task(0) == 0
+    assert c.get_map_task(0) == 1
+    c.report_map_task_finish(1, 1, 0)
+    assert c.get_map_task(0) == WAIT   # holder asks again: no self-copy
+    assert c.get_map_task() == WAIT    # anonymous poller: no copy either
+    assert c.stats()["totals"]["map"].get("speculation") is None
+
+
+def test_attemptless_finish_on_speculated_task_scores_wasted(tmp_path):
+    # A finish report with no attempt number (pre-attempt client, default
+    # caller) is unattributable — it must score CONSERVATIVELY as the
+    # original winning (wasted), never fabricate a speculation win with
+    # invented time saved.
+    cfg = make_cfg(tmp_path, 2, worker_n=2, speculate=True,
+                   speculate_after_frac=0.5)
+    c = Coordinator(cfg)
+    c.get_worker_id()
+    c.get_worker_id()
+    assert c.get_map_task(0) == 0
+    assert c.get_map_task(1) == 1
+    c.report_map_task_finish(1, 1, 1)
+    assert c.get_map_task(1) == 0          # speculative attempt 2
+    c.report_map_task_finish(0)            # attempt-less report
+    spec = c.stats()["totals"]["map"]["speculation"]
+    assert spec["won"] == 0 and spec["wasted"] == 1
+    assert spec["time_saved_s"] == 0.0
+
+
+def test_speculation_expiry_counts_wasted_and_regrants(tmp_path):
+    # Both attempts go silent: the shared lease expires, the speculation
+    # record resolves to wasted, and the task re-grants normally.
+    cfg = make_cfg(tmp_path, 2, worker_n=2, speculate=True,
+                   speculate_after_frac=0.5, lease_timeout_s=0.0)
+    c = Coordinator(cfg)
+    c.get_worker_id()
+    c.get_worker_id()
+    assert c.get_map_task(0) == 0
+    assert c.get_map_task(1) == 1
+    c.report_map_task_finish(1, 1, 1)
+    assert c.get_map_task(1) == 0      # speculative attempt 2
+    c.check_lease()                    # timeout 0: the shared lease dies
+    spec = c.stats()["totals"]["map"]["speculation"]
+    assert spec == {"attempts": 1, "won": 0, "wasted": 1, "time_saved_s": 0.0}
+    assert c.get_map_task(0) == 0      # normal re-grant, attempt 3
+    assert c.report.attempts("map", 0) == 3
+
+
+def test_revoked_renewal_sets_event_and_exits_loop(tmp_path):
+    # ISSUE 6 satellite: the cancelled speculative loser must exit its
+    # renewal loop cleanly (the bpo-42130 stop-flag machinery untouched)
+    # and surface the revocation so the task loop skips its report.
+    write_corpus(tmp_path)
+    cfg = make_cfg(tmp_path, len(TEXTS), worker_n=1,
+                   lease_renew_period_s=0.02)
+    w = Worker(cfg, engine="host")
+
+    class RevokingClient:
+        last_revoked = False
+        calls = 0
+
+        async def call(self, method, *params):
+            self.calls += 1
+            self.last_revoked = True   # envelope: task done elsewhere
+            return False
+
+    async def go():
+        stop = asyncio.Event()
+        revoked = asyncio.Event()
+        client = RevokingClient()
+        await asyncio.wait_for(
+            w._renewal_loop(client, "renew_map_lease", 0, stop, revoked),
+            timeout=5.0,
+        )
+        assert client.calls == 1       # one failed renewal is enough
+        assert revoked.is_set()
+        # And the level-triggered stop flag still wins over everything:
+        # a loop started with stop already set never calls out at all.
+        stop2 = asyncio.Event()
+        stop2.set()
+        quiet = RevokingClient()
+        await asyncio.wait_for(
+            w._renewal_loop(quiet, "renew_map_lease", 0, stop2,
+                            asyncio.Event()),
+            timeout=5.0,
+        )
+        assert quiet.calls == 0
+
+    asyncio.run(go())
+
+
+def test_expired_but_unfinished_lease_is_not_revocation(tmp_path):
+    # The other False-renewal: lease expired but the task is NOT done —
+    # the worker must keep computing (its late report is a genuine
+    # completion), so the envelope says revoked=False.
+    write_corpus(tmp_path)
+    cfg = make_cfg(tmp_path, len(TEXTS), worker_n=1, lease_timeout_s=0.0)
+
+    async def go():
+        coord = Coordinator(cfg)
+        serve = asyncio.create_task(coord.serve())
+        await asyncio.sleep(0.1)
+        client = CoordinatorClient(cfg.host, cfg.port, timeout_s=5.0)
+        await client.connect()
+        try:
+            await client.call("get_worker_id")
+            tid = await client.call("get_map_task", 0)
+            coord.check_lease()        # timeout 0: expire it immediately
+            ok = await client.call("renew_map_lease", tid, 0)
+            assert ok is False
+            assert client.last_revoked is False   # expired ≠ revoked
+        finally:
+            await client.close()
+            serve.cancel()
+            await asyncio.gather(serve, return_exceptions=True)
+
+    asyncio.run(go())
+
+
+def test_graceful_drain_deregisters_and_survivor_finishes(tmp_path):
+    # SIGTERM drain semantics, in-process: the draining worker finishes
+    # its current task, reports it, deregisters, and exits cleanly while
+    # the survivor completes the job — and watch/progress shows DRAINED,
+    # not a crash.
+    write_corpus(tmp_path)
+    cfg = make_cfg(tmp_path, len(TEXTS), worker_n=2)
+
+    class DrainAfterFirstTask(Worker):
+        def run_map_task(self, tid: int) -> None:
+            super().run_map_task(tid)
+            self.request_drain()   # as a SIGTERM mid-task would
+
+    async def cluster():
+        coord = Coordinator(cfg)
+        serve = asyncio.create_task(coord.serve())
+        await asyncio.sleep(0.1)
+        drainer = DrainAfterFirstTask(cfg, engine="host")
+        survivor = Worker(cfg, engine="host")
+        await asyncio.wait_for(
+            asyncio.gather(drainer.run(), survivor.run()), timeout=60
+        )
+        await asyncio.wait_for(serve, timeout=30)
+        return coord, drainer
+
+    coord, drainer = asyncio.run(cluster())
+    assert read_outputs(cfg) == oracle()
+    assert drainer.drained is True
+    assert coord.drained == {drainer.worker_id}
+    prog = coord.progress()
+    assert prog["workers"]["drained"] == [drainer.worker_id]
+    assert prog["workers"]["active"] == 1
+    # The drained worker ran exactly its one map task, nothing after.
+    rep = coord.stats()
+    w = rep["workers"][str(drainer.worker_id)]
+    assert w["reports"] == 1
+    from mapreduce_rust_tpu.runtime.telemetry import format_progress
+
+    assert "drained" in format_progress(rep)
+
+
+def test_deregister_rejects_unknown_wids(tmp_path):
+    cfg = make_cfg(tmp_path, 1, worker_n=1)
+    c = Coordinator(cfg)
+    assert c.deregister_worker(0) is False    # never registered
+    assert c.deregister_worker(-1) is False
+    c.get_worker_id()
+    assert c.deregister_worker(0) is True
+    assert c.deregister_worker(0) is True     # idempotent
+
+
+def test_backoff_envelope_cap_budget_and_reset():
+    import random
+
+    import pytest
+
+    from mapreduce_rust_tpu.runtime.backoff import Backoff, BackoffExhausted
+
+    # No jitter: the envelope is exactly base * factor^n, capped.
+    b = Backoff(0.1, cap_s=0.5, factor=2.0, jitter=0.0)
+    assert [round(b.next_delay(), 3) for _ in range(5)] == \
+        [0.1, 0.2, 0.4, 0.5, 0.5]
+    b.reset()
+    assert round(b.next_delay(), 3) == 0.1
+    # Jitter only shrinks delays (decorrelation must never exceed the cap).
+    bj = Backoff(0.1, cap_s=0.5, jitter=0.5, rng=random.Random(7))
+    for _ in range(20):
+        assert 0.0 < bj.next_delay() <= 0.5
+    # The budget bounds TOTAL sleep and then surfaces the exhaustion.
+    bb = Backoff(0.1, cap_s=10.0, budget_s=1.0, jitter=0.0)
+    total = 0.0
+    with pytest.raises(BackoffExhausted):
+        while True:
+            total += bb.next_delay()
+    assert total <= 1.0 + 1e-9
+    with pytest.raises(ValueError):
+        Backoff(0.0)
+    with pytest.raises(ValueError):
+        Backoff(0.1, factor=0.5)
+
+
+def test_call_retry_reconnects_after_transient_timeout(tmp_path):
+    # A coordinator that wedges for one call and then recovers: the
+    # worker's task-loop RPC retries on a fresh connection under backoff
+    # instead of dying on the first RpcTimeout.
+    write_corpus(tmp_path)
+    cfg = make_cfg(tmp_path, len(TEXTS), worker_n=1,
+                   rpc_timeout_s=0.3, rpc_backoff_base_s=0.02,
+                   rpc_backoff_cap_s=0.1, rpc_backoff_budget_s=5.0)
+    w = Worker(cfg, engine="host")
+    connections = []
+
+    async def go():
+        async def handler(reader, writer):
+            connections.append(writer)
+            line = await reader.readline()
+            if len(connections) == 1:
+                return  # wedge: swallow the request, never answer
+            import json as _json
+
+            req = _json.loads(line)
+            writer.write(_json.dumps(
+                {"id": req["id"], "result": 7}
+            ).encode() + b"\n")
+            await writer.drain()
+
+        server = await asyncio.start_server(handler, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        client = CoordinatorClient("127.0.0.1", port, timeout_s=0.3)
+        await client.connect()
+        try:
+            result = await asyncio.wait_for(
+                w._call_with_retry(client, "get_map_task", 0), timeout=10
+            )
+            assert result == 7
+            assert len(connections) == 2   # wedged once, retried once
+        finally:
+            await client.close()
+            server.close()
+            await server.wait_closed()
+
+    asyncio.run(go())
+
+
+def test_worker_manifest_carries_device_memory_gauge(tmp_path):
+    # PR 5 leftover: the worker task loop samples device memory too (not
+    # only the single-host drain loops) — the worker manifest carries
+    # device_mem_high_bytes. On the CPU test backend memory_stats() is
+    # empty so the high water stays 0; the contract here is that the
+    # field exists, sampling ran, and — critically — sampling NEVER
+    # initializes a backend by itself (a metadata probe against an
+    # absent accelerator would wedge the worker for minutes).
+    import json
+
+    write_corpus(tmp_path)
+    cfg = make_cfg(
+        tmp_path, len(TEXTS), worker_n=1, device="cpu",
+        merge_capacity=1 << 12,
+        manifest_path=str(tmp_path / "manifest.json"),
+    )
+    _coord, ws = asyncio.run(_run_cluster(cfg, 1, engine="device"))
+    # The device engine initialized the backend, so sampling engaged.
+    from jax._src import xla_bridge
+
+    assert xla_bridge._backends, "device engine should have a live backend"
+    manifests = list(pathlib.Path(tmp_path).glob("manifest-w*.json"))
+    assert len(manifests) == 1
+    m = json.loads(manifests[0].read_text())
+    assert m["kind"] == "worker_manifest"
+    assert "device_mem_high_bytes" in m
+    assert m["device_mem_high_bytes"] >= 0
+
+
+def test_sample_memory_never_initializes_a_backend(tmp_path):
+    # The wedge guard, directly: with jax absent from sys.modules the
+    # gauge is a no-op; the worker must consult the initialized-backends
+    # table rather than calling a device API that would trigger init.
+    import sys as _sys
+
+    write_corpus(tmp_path)
+    cfg = make_cfg(tmp_path, len(TEXTS), worker_n=1)
+    w = Worker(cfg, engine="host")
+    jax_mod = _sys.modules.pop("jax", None)
+    try:
+        w._sample_memory()  # no jax: no-op, no import
+        assert "jax" not in _sys.modules
+    finally:
+        if jax_mod is not None:
+            _sys.modules["jax"] = jax_mod
+    w._sample_memory()  # jax present (conftest initialized cpu): harmless
+    assert w._mem.device_mem_high_bytes >= 0
+
+
 def test_cli_merge_and_clean(tmp_path):
     write_corpus(tmp_path)
     cfg = make_cfg(tmp_path, len(TEXTS), worker_n=1)

@@ -204,6 +204,131 @@ def test_empty_manifest_is_flagged_not_crashed():
     assert any(f["code"] == "no-telemetry" for f in diag["findings"])
 
 
+def test_speculation_effectiveness_finding():
+    # ISSUE 6: a report whose totals carry speculation blocks yields the
+    # effectiveness finding (won/wasted attempts, est. time saved),
+    # summed across phases.
+    report = {
+        "totals": {
+            "map": {"tasks": 4, "completed": 4, "re_executions": 1,
+                    "expiries": 0, "late_reports": 0,
+                    "speculation": {"attempts": 2, "won": 1, "wasted": 1,
+                                    "time_saved_s": 3.5}},
+            "reduce": {"tasks": 2, "completed": 2, "re_executions": 0,
+                       "expiries": 0, "late_reports": 0,
+                       "speculation": {"attempts": 1, "won": 1, "wasted": 0,
+                                       "time_saved_s": 1.0}},
+        },
+    }
+    diag = diagnose({"kind": "job_report"}, job_report=report)
+    assert diag["speculation"] == {
+        "attempts": 3, "won": 2, "wasted": 1, "time_saved_s": 4.5,
+    }
+    f = next(
+        f for f in diag["findings"] if f["code"] == "speculation-effectiveness"
+    )
+    assert f["severity"] == "info" and "4.50s saved" in f["message"]
+    assert "speculation:" in format_diagnosis(diag)
+    # No speculation anywhere → no finding, no block.
+    quiet = diagnose({"kind": "job_report"}, job_report={"totals": {
+        "map": {"tasks": 1, "completed": 1, "re_executions": 0,
+                "expiries": 0, "late_reports": 0},
+    }})
+    assert "speculation" not in quiet
+    # All attempts losing is its own warning (duplicating healthy tasks).
+    wasteful = diagnose({"kind": "job_report"}, job_report={"totals": {
+        "map": {"tasks": 4, "completed": 4, "re_executions": 0,
+                "expiries": 0, "late_reports": 0,
+                "speculation": {"attempts": 3, "won": 0, "wasted": 3,
+                                "time_saved_s": 0.0}},
+    }})
+    assert any(
+        f["code"] == "speculation-wasteful" for f in wasteful["findings"]
+    )
+
+
+# ---------------------------------------------------------------------------
+# doctor trend (ISSUE 6 satellite: N-round drift over history.jsonl)
+# ---------------------------------------------------------------------------
+
+def _history(tmp_path, values, key="value") -> str:
+    p = tmp_path / "history.jsonl"
+    with open(p, "w") as f:
+        for v in values:
+            f.write(json.dumps({key: v, "metric": "m"}) + "\n")
+    return str(p)
+
+
+def test_trend_stable_series_passes(tmp_path):
+    from mapreduce_rust_tpu.analysis.doctor import analyze_trend
+
+    t = analyze_trend([{"value": v} for v in
+                       [1.0, 1.02, 0.99, 1.01, 1.0, 0.98, 1.02, 1.0]])
+    assert t["series"]["value"]["status"] == "stable"
+    assert t["drifts"] == []
+
+
+def test_trend_detects_sustained_drift_pairwise_gate_misses(tmp_path):
+    from mapreduce_rust_tpu.analysis.doctor import analyze_trend
+
+    # -3% every round: each PAIR is inside the 10% pairwise threshold,
+    # but the window loses ~25% — exactly the drift class `doctor trend`
+    # exists to catch.
+    values = [round(1.0 * (0.97 ** i), 4) for i in range(9)]
+    t = analyze_trend([{"value": v} for v in values])
+    assert t["series"]["value"]["status"] == "drifting"
+    assert t["drifts"] and t["drifts"][0]["metric"] == "value"
+    # A single-round dip does NOT count as sustained (slope stays flat).
+    blip = [1.0, 1.0, 1.01, 0.99, 1.0, 1.0, 1.0, 0.85]
+    t2 = analyze_trend([{"value": v} for v in blip])
+    assert t2["drifts"] == []
+    # An old, recovered dip doesn't count either (endpoint is healthy).
+    recovered = [1.0, 0.7, 0.7, 0.75, 0.9, 1.0, 1.0, 1.0]
+    t3 = analyze_trend([{"value": v} for v in recovered])
+    assert t3["drifts"] == []
+
+
+def test_trend_insufficient_data_is_not_a_drift(tmp_path):
+    from mapreduce_rust_tpu.analysis.doctor import analyze_trend
+
+    t = analyze_trend([{"value": 1.0}, {"value": 0.5}])
+    assert t["series"]["value"]["status"] == "insufficient"
+    assert t["drifts"] == []
+
+
+def test_trend_cli_exit_codes(tmp_path, capsys):
+    stable = _history(tmp_path, [1.0, 1.01, 0.99, 1.0, 1.0, 1.01])
+    assert main(["doctor", "trend", stable]) == 0
+    out = capsys.readouterr().out
+    assert "no sustained drift" in out
+
+    drifty = tmp_path / "drift.jsonl"
+    with open(drifty, "w") as f:
+        f.write("this line is torn garbage\n")  # must be skipped, not fatal
+        for i in range(9):
+            f.write(json.dumps({"value": 1.0 - 0.04 * i}) + "\n")
+    assert main(["doctor", "trend", str(drifty)]) == 1
+    out = capsys.readouterr().out
+    assert "SUSTAINED DRIFT" in out
+
+    assert main(["doctor", "trend", str(tmp_path / "missing.jsonl")]) == 2
+    capsys.readouterr()  # drain the error line before the JSON check
+    # JSON shape for CI diffs.
+    assert main(["doctor", "trend", str(drifty), "--format", "json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["kind"] == "doctor_trend" and doc["drifts"]
+    # Chaos rows (value=None) never pollute the watched series.
+    mixed = tmp_path / "mixed.jsonl"
+    with open(mixed, "w") as f:
+        for v in [1.0, 1.0, 1.01, 0.99, 1.0, 1.0]:
+            f.write(json.dumps({"value": v}) + "\n")
+        for _ in range(6):
+            f.write(json.dumps(
+                {"value": None, "chaos_scenario": "kill", "chaos_wall_s": 9.0}
+            ) + "\n")
+    assert main(["doctor", "trend", str(mixed)]) == 0
+
+
 # ---------------------------------------------------------------------------
 # regression gate units
 # ---------------------------------------------------------------------------

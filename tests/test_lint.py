@@ -459,6 +459,108 @@ def test_psum_replicated_flag_scopes_per_function(tmp_path):
     """) == []
 
 
+# ---------------------------------------------------------------------------
+# unbounded-retry
+# ---------------------------------------------------------------------------
+
+def test_unbounded_retry_fires_on_constant_sleep_in_except(tmp_path):
+    findings, _ = run_lint(tmp_path, """
+        import time
+
+        def connect_forever(host):
+            while True:
+                try:
+                    return open_connection(host)
+                except OSError:
+                    time.sleep(0.1)   # the ISSUE 6 bug class: fixed-rate
+                                      # retry, forever, error never surfaces
+    """)
+    assert [f.rule for f in findings] == ["unbounded-retry"]
+    assert "Backoff" in findings[0].message
+
+
+def test_unbounded_retry_fires_on_exitless_constant_poll(tmp_path):
+    assert rules_fired(tmp_path, """
+        import time
+
+        def poll(worker):
+            while True:
+                worker.tick()
+                time.sleep(1.0)       # no break/return/raise: spins forever
+    """) == ["unbounded-retry"]
+
+
+def test_unbounded_retry_fires_on_unreassigned_name_delay(tmp_path):
+    # A delay held in a variable that never changes inside the loop is
+    # still a constant sleep.
+    assert rules_fired(tmp_path, """
+        import time
+
+        def retry(fn, delay):
+            while True:
+                try:
+                    return fn()
+                except ValueError:
+                    time.sleep(delay)
+    """) == ["unbounded-retry"]
+
+
+def test_unbounded_retry_silent_on_backoff_delays(tmp_path):
+    # The shipped-fix pattern: delays drawn from a Backoff — a call, so
+    # the delay is assumed to grow.
+    assert rules_fired(tmp_path, """
+        import time
+        from mapreduce_rust_tpu.runtime.backoff import Backoff
+
+        def retry(fn):
+            backoff = Backoff(0.05, 2.0, budget_s=60.0)
+            while True:
+                try:
+                    return fn()
+                except ValueError:
+                    time.sleep(backoff.next_delay())
+    """) == []
+
+
+def test_unbounded_retry_silent_on_bounded_and_conditioned_loops(tmp_path):
+    assert rules_fired(tmp_path, """
+        import time
+
+        def bounded(fn, retries=5):
+            for attempt in range(retries):   # a For is inherently bounded
+                try:
+                    return fn()
+                except ValueError:
+                    if attempt == retries - 1:
+                        raise
+                    time.sleep(0.1)
+
+        def conditioned(stop):
+            while not stop.is_set():         # the test IS the stop condition
+                time.sleep(0.2)
+
+        def raising(fn):
+            attempt = 0
+            while True:
+                try:
+                    return fn()
+                except ValueError:
+                    attempt += 1
+                    if attempt > 3:
+                        raise                # bounded by the raise
+                    time.sleep(0.1)
+
+        def growing(fn):
+            delay = 0.1
+            while True:
+                try:
+                    return fn()
+                except ValueError:
+                    time.sleep(delay)
+                    delay = delay * 2        # reassigned: a hand-rolled backoff
+    """) == []
+
+
 BAD_SNIPPET = """
     def shard(dictionary):
         return list(dictionary.items())
