@@ -964,6 +964,7 @@ def chaos_legs() -> None:
 
     from mapreduce_rust_tpu.analysis.chaos import SCENARIOS
     from mapreduce_rust_tpu.analysis.doctor import diagnose
+    from mapreduce_rust_tpu.analysis.mrcheck import run_check
     from mapreduce_rust_tpu.runtime.telemetry import load_manifest
 
     work_root = BENCH_DIR / "chaos"
@@ -1001,10 +1002,30 @@ def chaos_legs() -> None:
                 }
             except Exception as e:
                 r["doctor"] = {"error": repr(e)}
+        # mrcheck on the leg's control-plane artifacts (journal +
+        # job_report under work/): the matrix's real oracle — "bytes
+        # matched" says nothing about a double-granted lease or a report
+        # accepted after revoke, and a violation fails the leg LOUDLY
+        # even when the output happened to come out right (ISSUE 7).
+        try:
+            cdoc = run_check(str(pathlib.Path(r["dir"]) / "work"))
+            r["mrcheck"] = {
+                "ok": cdoc["ok"],
+                "violations": [
+                    f"[{v['code']}] {v['message']}"
+                    for v in cdoc["violations"][:6]
+                ],
+            }
+            if not cdoc["ok"]:
+                ok = False
+        except Exception as e:  # an uncheckable leg is a failed leg: the
+            ok = False          # oracle must never silently not run
+            r["mrcheck"] = {"ok": False, "error": repr(e)}
         ok = ok and r.get("recovered", False) and r["bit_identical"]
         rows.append(r)
         print(f"chaos {name}: wall={r.get('wall_s')}s recovered="
-              f"{r.get('recovered')} identical={r['bit_identical']}",
+              f"{r.get('recovered')} identical={r['bit_identical']} "
+              f"mrcheck={'ok' if r['mrcheck']['ok'] else 'VIOLATION'}",
               file=sys.stderr)
         _append_history({
             "metric": f"chaos recovery ({name})",
@@ -1017,6 +1038,7 @@ def chaos_legs() -> None:
             "chaos_recovery_cost_s": r.get("recovery_cost_s"),
             "chaos_bit_identical": r["bit_identical"],
             "chaos_speculate": speculate,
+            "chaos_mrcheck": r["mrcheck"],
         })
     nospec = next((r for r in rows if r["scenario"] == "slow_scan-nospec"), None)
     spec = next((r for r in rows if r["scenario"] == "slow_scan-spec"), None)

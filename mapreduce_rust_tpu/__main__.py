@@ -8,6 +8,7 @@ src/clean.sh), as subcommands of one module:
     python -m mapreduce_rust_tpu merge       # mr-*.txt → final.txt
     python -m mapreduce_rust_tpu clean       # rm intermediates/outputs
     python -m mapreduce_rust_tpu doctor      # automated run diagnosis
+    python -m mapreduce_rust_tpu check       # protocol conformance + races
 
 Unlike the reference — where the worker learns map_n/reduce_n from its own
 argv and a mismatch silently mis-shards the shuffle (SURVEY.md §3-E) — both
@@ -384,6 +385,15 @@ def cmd_watch(args) -> int:
     return asyncio.run(go())
 
 
+def cmd_check(args) -> int:
+    """mrcheck: protocol conformance + happens-before race detection over
+    a run's control-plane artifacts (journal, job report, merged trace).
+    Backend-free like lint/doctor — the chaos matrix's real oracle."""
+    from mapreduce_rust_tpu.analysis.mrcheck import run_cli
+
+    return run_cli(args)
+
+
 def cmd_lint(args) -> int:
     """mrlint: the framework-invariant static analyzer (analysis/). Pure
     ast + stdlib — no jax import, so it runs in any process in
@@ -504,6 +514,34 @@ def main(argv: list[str] | None = None) -> int:
                    help="validate a written Chrome trace file instead of "
                    "linting source (span nesting, B/E balance, counter "
                    "value types)")
+    p.add_argument("--strict-baseline", action="store_true",
+                   dest="strict_baseline",
+                   help="promote unused baseline entries from a warning to "
+                   "exit 1 — stale suppressions must not accumulate (an "
+                   "unused entry will happily swallow a real finding at "
+                   "that path later)")
+    p.add_argument("-v", "--verbose", action="store_true")
+
+    p = sub.add_parser(
+        "check",
+        help="mrcheck: lease/attempt protocol conformance + happens-before "
+        "race detection over a run's control-plane artifacts",
+    )
+    p.add_argument("target",
+                   help="work dir (coordinator.journal + job_report.json), "
+                   "or a coordinator manifest / job_report.json")
+    p.add_argument("--trace", default=None, metavar="TRACE",
+                   help="merged (or per-process) trace: enables the "
+                   "happens-before race detector and the flow-terminator "
+                   "conformance check")
+    p.add_argument("--journal", default=None, metavar="PATH",
+                   help="explicit coordinator.journal path (default: "
+                   "resolved from the work dir / manifest config)")
+    p.add_argument("--job-report", default=None, metavar="PATH",
+                   dest="job_report",
+                   help="explicit job_report.json path")
+    p.add_argument("--format", choices=["text", "json"], default="text",
+                   help="json: the full conformance document for CI diffs")
     p.add_argument("-v", "--verbose", action="store_true")
 
     p = sub.add_parser("stats", help="pretty-print a run manifest, or diff two")
@@ -608,6 +646,7 @@ def main(argv: list[str] | None = None) -> int:
         "trace": cmd_trace,
         "watch": cmd_watch,
         "lint": cmd_lint,
+        "check": cmd_check,
     }[args.cmd](args)
 
 

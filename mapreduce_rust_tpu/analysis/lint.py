@@ -60,14 +60,14 @@ class LintReport:
         return not self.findings and not self.parse_errors
 
     def to_dict(self) -> dict:
-        from mapreduce_rust_tpu.analysis.rules import ALL_RULES
+        from mapreduce_rust_tpu.analysis.rules import ALL_RULES, PROGRAM_RULES
 
         return {
             "tool": "mrlint",
             "schema": 1,
             "ok": self.ok,
             "files_checked": self.files_checked,
-            "rules": sorted(r.name for r in ALL_RULES),
+            "rules": sorted(r.name for r in [*ALL_RULES, *PROGRAM_RULES]),
             "findings": [f.to_dict() for f in self.findings + self.parse_errors],
             "suppressed_inline": self.suppressed,
             "suppressed_baseline": self.baselined,
@@ -105,6 +105,13 @@ def qualname(node: ast.AST) -> str:
         parts.append(node.id)
         return ".".join(reversed(parts))
     return ""
+
+
+def last_segment(name: str) -> str:
+    """Last dotted segment of a qualname (``"a.b.c"`` → ``"c"``) — the
+    ONE suffix-matching helper rules and the dataflow call graph share,
+    so their notion of "same callable name" can never drift."""
+    return name.rsplit(".", 1)[-1]
 
 
 def enclosing_function(node: ast.AST) -> "ast.FunctionDef | ast.AsyncFunctionDef | None":
@@ -173,6 +180,10 @@ def load_baseline(path: str) -> list[dict]:
     a config error, raised loudly (CI must not silently suppress)."""
     with open(path) as f:
         data = json.load(f)
+    if not isinstance(data, dict):
+        raise ValueError(
+            f"{path}: baseline must be an object with a 'suppressions' list"
+        )
     entries = data.get("suppressions", [])
     if not isinstance(entries, list):
         raise ValueError(f"{path}: 'suppressions' must be a list")
@@ -251,24 +262,37 @@ def _rel(path: str) -> str:
     return rel.replace(os.sep, "/")
 
 
-def lint_file(path: str, rules: Iterable | None = None) -> tuple[list[Finding], list[Finding], int]:
-    """(findings, parse/suppression errors, inline-suppressed count)."""
-    from mapreduce_rust_tpu.analysis.rules import ALL_RULES
+@dataclasses.dataclass
+class ParsedFile:
+    """One linted file, parsed exactly once: the per-file rules, the
+    program rules (via dataflow.Program) and the suppression pass all
+    consume this instead of re-reading the source."""
 
-    rules = list(rules) if rules is not None else ALL_RULES
+    path: str
+    rel: str
+    tree: ast.Module
+    src: str
+    ignores: dict[int, set[str]]
+
+
+def parse_file(path: str) -> tuple["ParsedFile | None", list[Finding]]:
+    """(parsed file, parse/suppression errors). None on a parse failure —
+    the error Finding is the record of it."""
     rel = _rel(path)
     try:
         with open(path, "rb") as f:
             src = f.read().decode("utf-8", errors="replace")
         tree = ast.parse(src, filename=path)
     except (OSError, SyntaxError) as e:
-        return [], [Finding("parse-error", rel, getattr(e, "lineno", 1) or 1, 0,
-                            f"cannot lint: {e}")], 0
+        return None, [Finding("parse-error", rel, getattr(e, "lineno", 1) or 1,
+                              0, f"cannot lint: {e}")]
     attach_parents(tree)
     ignores, bad_ignores = _inline_ignores(src, rel)
-    findings: list[Finding] = []
-    for rule in rules:
-        findings.extend(rule.check(tree, src, rel))
+    return ParsedFile(path, rel, tree, src, ignores), bad_ignores
+
+
+def _suppress(findings: Iterable[Finding],
+              ignores: dict[int, set[str]]) -> tuple[list[Finding], int]:
     kept: list[Finding] = []
     suppressed = 0
     for f in sorted(findings, key=lambda f: (f.line, f.col, f.rule)):
@@ -279,19 +303,61 @@ def lint_file(path: str, rules: Iterable | None = None) -> tuple[list[Finding], 
             suppressed += 1
         else:
             kept.append(f)
-    return kept, bad_ignores, suppressed
+    return kept, suppressed
+
+
+def lint_file(path: str, rules: Iterable | None = None) -> tuple[list[Finding], list[Finding], int]:
+    """(findings, parse/suppression errors, inline-suppressed count).
+
+    Per-file rules only: the interprocedural program rules need the whole
+    file set and run from :func:`lint_paths`."""
+    from mapreduce_rust_tpu.analysis.rules import ALL_RULES
+
+    rules = list(rules) if rules is not None else ALL_RULES
+    pf, errors = parse_file(path)
+    if pf is None:
+        return [], errors, 0
+    findings: list[Finding] = []
+    for rule in rules:
+        findings.extend(rule.check(pf.tree, pf.src, pf.rel))
+    kept, suppressed = _suppress(findings, pf.ignores)
+    return kept, errors, suppressed
 
 
 def lint_paths(paths: Sequence[str] | None = None,
                baseline: list[dict] | None = None) -> LintReport:
+    from mapreduce_rust_tpu.analysis.rules import ALL_RULES, PROGRAM_RULES
+
     files = discover_files(list(paths) if paths else default_roots())
     report = LintReport(findings=[], files_checked=len(files))
-    used = [0] * len(baseline or [])
+    parsed: list[ParsedFile] = []
+    raw: dict[str, list[Finding]] = {}
     for path in files:
-        findings, errors, suppressed = lint_file(path)
-        report.suppressed += suppressed
+        pf, errors = parse_file(path)
         report.parse_errors.extend(errors)
-        for f in findings:
+        if pf is None:
+            continue
+        parsed.append(pf)
+        fs = raw.setdefault(pf.rel, [])
+        for rule in ALL_RULES:
+            fs.extend(rule.check(pf.tree, pf.src, pf.rel))
+    if PROGRAM_RULES and parsed:
+        # The interprocedural pass: one Program over every parsed file, so
+        # the call graph sees helper frames in other modules. Program
+        # findings land on their file and obey the SAME inline ignores and
+        # baseline as per-file findings.
+        from mapreduce_rust_tpu.analysis.dataflow import Program
+
+        program = Program([(pf.rel, pf.tree) for pf in parsed])
+        for rule in PROGRAM_RULES:
+            for f in rule.run_program(program):
+                raw.setdefault(f.path, []).append(f)
+    ignores_by_rel = {pf.rel: pf.ignores for pf in parsed}
+    used = [0] * len(baseline or [])
+    for rel in sorted(raw):
+        kept, suppressed = _suppress(raw[rel], ignores_by_rel.get(rel, {}))
+        report.suppressed += suppressed
+        for f in kept:
             hit = None
             for i, entry in enumerate(baseline or []):
                 if _baseline_match(entry, f):
@@ -338,9 +404,19 @@ def run_cli(args) -> int:
         return 2
 
     report = lint_paths(paths, baseline)
+    # Resolved BEFORE the document prints: under --strict-baseline a
+    # stale entry IS the failure, and the JSON "ok" field must agree with
+    # the exit code (a CI pipeline gating on the archived document would
+    # otherwise record a pass for a failed invocation).
+    strict_stale = bool(
+        getattr(args, "strict_baseline", False) and report.unused_baseline
+    )
 
     if getattr(args, "format", "text") == "json":
-        print(json.dumps(report.to_dict(), indent=2, sort_keys=True))
+        doc = report.to_dict()
+        if strict_stale:
+            doc["ok"] = False
+        print(json.dumps(doc, indent=2, sort_keys=True))
     else:
         for f in report.findings + report.parse_errors:
             print(f.format())
@@ -356,6 +432,18 @@ def run_cli(args) -> int:
             f"{report.suppressed} inline-suppressed, "
             f"{report.baselined} baselined"
         )
+    if strict_stale:
+        # Stale suppressions are debt with interest: an entry nothing
+        # matches today will happily swallow a REAL finding at that path
+        # tomorrow. --strict-baseline turns the warning into the failure
+        # it deserves so CI prunes them at the source.
+        print(
+            f"mrlint: --strict-baseline: {len(report.unused_baseline)} "
+            "unused baseline entr(y/ies) — remove them from the baseline "
+            "file",
+            file=sys.stderr,
+        )
+        return 1
     return 0 if report.ok else 1
 
 

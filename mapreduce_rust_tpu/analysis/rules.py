@@ -23,6 +23,7 @@ from mapreduce_rust_tpu.analysis.lint import (
     ancestors,
     enclosing_class,
     enclosing_function,
+    last_segment as _last_segment,
     qualname,
 )
 
@@ -42,10 +43,6 @@ class Rule:
     def finding(self, path: str, node: ast.AST, message: str) -> Finding:
         return Finding(self.name, path, getattr(node, "lineno", 1),
                        getattr(node, "col_offset", 0), message)
-
-
-def _last_segment(name: str) -> str:
-    return name.rsplit(".", 1)[-1]
 
 
 def _mentions(node: ast.AST, ident: str, substring: bool = False) -> bool:
@@ -784,6 +781,264 @@ class UnboundedRetryRule(Rule):
             )
 
 
+# ---------------------------------------------------------------------------
+# Interprocedural program rules (the ISSUE 7 dataflow layer)
+# ---------------------------------------------------------------------------
+
+
+class ProgramRule(Rule):
+    """A rule that runs once over the whole linted file set with the
+    dataflow layer (analysis/dataflow.py): CFG + reaching definitions per
+    function, and a package call graph so a value or a hazard can be
+    followed across frames. Findings land on their file and obey the same
+    inline ignores and baseline as per-file findings."""
+
+    def run_program(self, program) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def check(self, tree, src, path):  # pragma: no cover - program-only
+        return []
+
+
+def _call_chain(path_frames) -> str:
+    """Render a call path as ``a -> b -> c`` for finding messages."""
+    return " -> ".join(fu.qualname for fu, _call in path_frames)
+
+
+class BlockingInAsyncRule(ProgramRule):
+    """No blocking calls reachable inside ``async def`` — directly or
+    through sync helper frames.
+
+    Incident: the renewal/backoff loops live on the event loop; a single
+    ``time.sleep`` (or a subprocess wait) anywhere in their call closure
+    starves EVERY coroutine in the process — renewals stop, leases expire
+    under live tasks, and the failure reads as a distributed timing bug
+    instead of the local blocking call it is. The chaos sites dodge this
+    only because task bodies run in the executor (``run_in_executor``),
+    which is exactly the boundary this rule understands: callables merely
+    PASSED to an executor sink are not async-context callees.
+    """
+
+    name = "blocking-in-async"
+    summary = "no time.sleep/subprocess/socket waits reachable from async def"
+
+    #: qualname -> why it blocks. Bare last-segment matches are accepted
+    #: only for names that unambiguously come from these modules
+    #: (from-import detection below).
+    _BLOCKING_ROOTS = {
+        "time": {"sleep"},
+        "subprocess": {"run", "call", "check_call", "check_output", "Popen"},
+        "os": {"system", "wait", "waitpid"},
+        "socket": {"create_connection"},
+        "urllib.request": {"urlopen"},
+    }
+
+    def _from_imports(self, tree) -> dict[str, str]:
+        """bare name -> source module, for ``from time import sleep``."""
+        out: dict[str, str] = {}
+        for n in ast.walk(tree):
+            if isinstance(n, ast.ImportFrom) and n.module:
+                for alias in n.names:
+                    out[alias.asname or alias.name] = n.module
+        return out
+
+    def _is_blocking(self, call, from_imports) -> "str | None":
+        q = qualname(call.func)
+        if not q:
+            return None
+        last = _last_segment(q)
+        for root, names in self._BLOCKING_ROOTS.items():
+            if last not in names:
+                continue
+            if q == f"{root}.{last}" or q.endswith(f".{root}.{last}"):
+                return f"{root}.{last}"
+            if q == last and from_imports.get(last) == root:
+                return f"{root}.{last}"
+        return None
+
+    def run_program(self, program):
+        from_imports_by_path: dict[str, dict] = {}
+        for path, tree in program.files:
+            from_imports_by_path[path] = self._from_imports(tree)
+        seen: set[tuple[str, int]] = set()
+        for root in program.functions:
+            if not root.is_async:
+                continue
+            frames = [(root, [])] + program.reachable(root)
+            for fu, chain in frames:
+                imports = from_imports_by_path.get(fu.path, {})
+                for call, _target in program.callees(fu):
+                    blocked = self._is_blocking(call, imports)
+                    if blocked is None:
+                        continue
+                    key = (fu.path, getattr(call, "lineno", 0))
+                    if key in seen:
+                        continue  # one finding per site, however many
+                    seen.add(key)  # async roots reach it
+                    via = (
+                        f" via {_call_chain(chain)} -> {fu.qualname}"
+                        if chain else ""
+                    )
+                    yield self.finding(
+                        fu.path, call,
+                        f"{blocked!r} reached inside async def "
+                        f"{root.qualname}{via} — a blocking call on the "
+                        "event loop starves every coroutine (renewals "
+                        "stop, leases expire under live tasks); await "
+                        "asyncio.sleep, or move the work to "
+                        "run_in_executor",
+                    )
+
+
+class BackendInitInProbeRule(ProgramRule):
+    """Telemetry probes must not initialize a jax backend.
+
+    Incident: PR 6's worker device-memory gauge called
+    ``jax.local_devices()`` from the task loop; on a process whose
+    backend was NOT yet initialized that call *triggers* backend init — a
+    ~minutes-long metadata probe against an absent accelerator that
+    wedged the worker. The fix gates the gauge on
+    ``jax._src.xla_bridge._backends`` (already-initialized check). This
+    rule walks every probe-named function (``sample``/``probe``/
+    ``gauge``/``platform_info`` — the repo's telemetry naming convention)
+    and its sync call closure: any path to ``jax.devices()`` /
+    ``jax.local_devices()`` / ``memory_stats()`` must be dominated by a
+    ``_backends`` guard, at the device call or at the call site leading
+    to it (branch-sensitive: the ``if not _backends: return`` early exit
+    counts, including inside try/except).
+    """
+
+    name = "backend-init-in-probe"
+    summary = "telemetry probes gate device access on xla_bridge._backends"
+
+    _PROBE = ("sample", "probe", "gauge", "platform_info")
+    _DEVICE = ("local_devices", "devices", "memory_stats")
+
+    def _is_probe(self, fu) -> bool:
+        low = fu.name.lower()
+        return any(p in low for p in self._PROBE)
+
+    def _device_calls(self, program, fu):
+        for call, _t in program.callees(fu):
+            if _last_segment(qualname(call.func)) in self._DEVICE:
+                yield call
+
+    def run_program(self, program):
+        from mapreduce_rust_tpu.analysis.dataflow import guarded_reach
+
+        seen: set[tuple[str, int]] = set()
+        for root in program.functions:
+            if not self._is_probe(root):
+                continue
+            frames = [(root, [])] + program.reachable(root)
+            for fu, chain in frames:
+                for call in self._device_calls(program, fu):
+                    if guarded_reach(fu.cfg, call, "_backends"):
+                        continue
+                    # A hop guarded at its CALL SITE covers the callee:
+                    # the probe checked before descending.
+                    if any(
+                        guarded_reach(src.cfg, site, "_backends")
+                        for src, site in chain
+                    ):
+                        continue
+                    key = (fu.path, getattr(call, "lineno", 0))
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    via = (
+                        f" (reached from probe {root.qualname} via "
+                        f"{_call_chain(chain)})" if chain else ""
+                    )
+                    yield self.finding(
+                        fu.path, call,
+                        f"{qualname(call.func)!r} in telemetry probe "
+                        f"{root.qualname}{via} without the "
+                        "xla_bridge._backends guard — on an uninitialized "
+                        "process this CALL initializes the backend (a "
+                        "~minutes metadata probe against an absent "
+                        "accelerator wedged a worker, PR 6); check "
+                        "`if not xla_bridge._backends: return` first",
+                    )
+
+
+class NondeterministicPartitionRule(ProgramRule):
+    """No unordered-set iteration flowing into partition/shard indexing.
+
+    The framework's headline invariant is BIT-IDENTICAL outputs — for any
+    worker count, any recovery path, any speculation race. Iterating a
+    ``set`` (hash-randomized for str keys) while computing a partition or
+    shard index makes the spill ROW ORDER depend on interpreter hash
+    state: two attempts of one task then write permuted rows, and the
+    "outputs identical" oracle fails only on the rerun nobody can
+    reproduce. The shipped pattern sorts first (``for d in sorted(v)``,
+    worker/runtime.py); this rule follows values through reaching
+    definitions (``pending = seen; for d in pending: ...``) so an alias
+    can't hide the set. Dict iteration is insertion-ordered on every
+    supported interpreter and deliberately does not fire.
+    """
+
+    name = "nondeterministic-partition-input"
+    summary = "sort set-typed values before they feed partition/shard indexing"
+
+    _PART_HINT = ("reduce_n", "partition", "shard", "n_part", "nparts",
+                  "parts", "buckets")
+
+    def _is_set_expr(self, expr) -> bool:
+        if isinstance(expr, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(expr, ast.Call) and \
+                _last_segment(qualname(expr.func)) in ("set", "frozenset"):
+            return True
+        return False
+
+    def _partitionish(self, node) -> bool:
+        """Does a subtree compute a partition/shard index? ``x % NAME``
+        with a partition-hinted NAME, or a subscript into one."""
+        for n in ast.walk(node):
+            if isinstance(n, ast.BinOp) and isinstance(n.op, ast.Mod):
+                names = " ".join(
+                    q for q in (qualname(n.right), qualname(n.left)) if q
+                ).lower()
+                if any(h in names for h in self._PART_HINT):
+                    return True
+            if isinstance(n, ast.Subscript):
+                if any(h in qualname(n.value).lower()
+                       for h in self._PART_HINT):
+                    return True
+        return False
+
+    def run_program(self, program):
+        from mapreduce_rust_tpu.analysis.dataflow import origins
+
+        for fu in program.functions:
+            defs = reach = None
+            for n in program._own_walk(fu.node):
+                if not isinstance(n, (ast.For, ast.AsyncFor)):
+                    continue
+                it = n.iter
+                set_like = self._is_set_expr(it)
+                if not set_like and isinstance(it, ast.Name):
+                    if defs is None:
+                        defs, reach = fu.rd
+                    set_like = any(
+                        o is not None and self._is_set_expr(o)
+                        for o in origins(fu.cfg, defs, reach, it)
+                    )
+                if not set_like:
+                    continue
+                if not (self._partitionish(n) or self._partitionish(it)):
+                    continue
+                yield self.finding(
+                    fu.path, n,
+                    "iterating an unordered set into a partition/shard "
+                    "index — row order then depends on interpreter hash "
+                    "state and two attempts of one task write permuted "
+                    "spills, breaking the bit-identical-outputs "
+                    "invariant; iterate sorted(...) instead",
+                )
+
+
 ALL_RULES: list[Rule] = [
     StatsOwnershipRule(),
     ExecutorTeardownRule(),
@@ -795,4 +1050,13 @@ ALL_RULES: list[Rule] = [
     JitInLoopRule(),
     PsumReplicatedFlagRule(),
     UnboundedRetryRule(),
+]
+
+#: Interprocedural rules: run once per lint over the whole file set, on
+#: the shared dataflow layer. Kept separate so ``lint_file`` (single-file
+#: consumers, fixture tests) stays cheap and self-contained.
+PROGRAM_RULES: list[ProgramRule] = [
+    BlockingInAsyncRule(),
+    BackendInitInProbeRule(),
+    NondeterministicPartitionRule(),
 ]

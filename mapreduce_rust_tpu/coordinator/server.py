@@ -34,15 +34,18 @@ counterpart of tarpc's Json TCP transport (src/bin/mrcoordinator.rs:31-43).
 from __future__ import annotations
 
 import asyncio
+import itertools
 import json
 import logging
 import os
+import uuid
 import time
 
 from mapreduce_rust_tpu.config import Config
 from mapreduce_rust_tpu.runtime.telemetry import JobReport, write_job_report
 from mapreduce_rust_tpu.runtime.backoff import Backoff, BackoffExhausted
 from mapreduce_rust_tpu.runtime.trace import (
+    active_tracer,
     partial_path,
     per_process_path,
     start_tracing,
@@ -57,6 +60,16 @@ log = logging.getLogger("mapreduce_rust_tpu.coordinator")
 NOT_READY = -2   # phase gate / registration barrier
 WAIT = -3        # all assigned, leases outstanding — straggler wait
 DONE = -1        # phase complete
+
+# Per-process RPC call-id mint (see CoordinatorClient.call): the client
+# half of the happens-before bracket mrcheck traverses. The prefix is a
+# RANDOM process token, deliberately not the pid: trace merge accepts
+# files from different hosts whose pids collide (it remaps the pids, but
+# cids ride inside event args), and a collided cid would fabricate
+# send→handle edges between unrelated processes — ordering two genuinely
+# concurrent writes is exactly how a race detector goes blind.
+_rpc_run = uuid.uuid4().hex[:12]
+_rpc_cid = itertools.count(1)
 
 
 class RpcTimeout(RuntimeError):
@@ -242,9 +255,17 @@ class Coordinator:
             return
         for line in lines[1:]:
             try:
-                phase_name, tid_s = line.split()
+                # Two fields is the original record; later fields (attempt,
+                # wid, wall-clock — `map 3 a2 w1 t12.345`) are mrcheck
+                # context and ignored here, so a pre-annotation journal
+                # resumes under this coordinator. (The reverse does NOT
+                # hold: a pre-annotation coordinator's strict 2-tuple
+                # unpack skips annotated lines, so a rollback re-executes
+                # from scratch — resume value lost, never corrupted.)
+                parts = line.split()
+                phase_name, tid_s = parts[0], parts[1]
                 tid = int(tid_s)
-            except ValueError:
+            except (ValueError, IndexError):
                 continue
             if phase_name not in ("map", "reduce"):
                 continue  # corrupt record — never guess a phase
@@ -266,14 +287,28 @@ class Coordinator:
                 sum(self.reduce.assigned.values()), self.reduce.n,
             )
 
-    def _journal(self, phase_name: str, tid: int) -> None:
+    def _journal(self, phase_name: str, tid: int, attempt: int = 0,
+                 wid: int = -1) -> None:
+        # The line carries the WINNING attempt, the reporting worker and
+        # the report-clock timestamp beside the completion record — the
+        # annotations mrcheck replays (revoked attempt never journals,
+        # at-most-one winner) and prints as wall-clock context. Replay
+        # reads only the first two fields, so this coordinator still
+        # resumes pre-annotation journals (see _replay_journal for why
+        # the reverse is forward-only).
         try:
             os.makedirs(self.cfg.work_dir, exist_ok=True)
             fresh = not os.path.exists(self._journal_path)
             with open(self._journal_path, "a") as f:
                 if fresh:
                     f.write(self._header() + "\n")
-                f.write(f"{phase_name} {tid}\n")
+                f.write(f"{phase_name} {tid} a{attempt} w{wid} "
+                        f"t{self.report.uptime_s():.3f}\n")
+            # The journal append IS the authoritative (phase, tid) state
+            # write: an instant beside the rpc span makes it a node the
+            # happens-before race detector can order.
+            trace_instant("coordinator.journal", phase=phase_name, tid=tid,
+                          attempt=attempt, wid=wid)
         except OSError as e:
             log.warning("journal write failed: %s", e)
 
@@ -426,7 +461,8 @@ class Coordinator:
                     name, tid, "won" if won else "wasted", attempt,
                     f", ~{saved:.2f}s saved vs lease expiry" if won else "",
                 )
-        self.report.record_finish(name, tid, late=not first, wid=wid)
+        self.report.record_finish(name, tid, late=not first, wid=wid,
+                                  attempt=attempt or None)
         fid = f"{name}:{tid}:{attempt or self.report.attempts(name, tid)}"
         if fid not in self._flow_finished:
             # Guard the flow chain's single-finish invariant even if two
@@ -435,7 +471,7 @@ class Coordinator:
             self._flow_finished.add(fid)
             trace_flow("task", "f", fid, phase=name, tid=tid)
         if first:
-            self._journal(name, tid)
+            self._journal(name, tid, attempt=attempt, wid=wid)
         return done
 
     def report_map_task_finish(self, tid: int, attempt: int = 0,
@@ -459,6 +495,7 @@ class Coordinator:
         if not isinstance(wid, int) or wid < 0 or wid >= self.worker_count:
             return False
         self.drained.add(wid)
+        self.report.record_deregister(wid)
         log.info("worker %d deregistered (graceful drain)", wid)
         return True
 
@@ -569,7 +606,14 @@ class Coordinator:
                     # Per-RPC spans are control-plane rate (worker polls +
                     # renewals), not data-plane rate — bounded, not per-record.
                     t0 = time.perf_counter()
-                    with trace_span(f"rpc.{method}"):
+                    # ``cid`` is the client's per-call id (rpc.send /
+                    # rpc.recv instants carry the same one): the span
+                    # becomes the server half of a request/response
+                    # happens-before pair mrcheck can traverse.
+                    span_args = (
+                        {"cid": req["cid"]} if req.get("cid") else {}
+                    )
+                    with trace_span(f"rpc.{method}", **span_args):
                         result = getattr(self, method)(*req.get("params", []))
                     self.report.record_rpc(method, time.perf_counter() - t0)
                     # "now" is the NTP-style timestamp ClockSync brackets:
@@ -605,6 +649,15 @@ class Coordinator:
                             else self.reduce
                         params = req.get("params") or [None]
                         resp["revoked"] = params[0] in ph.reported
+                        if resp["revoked"]:
+                            # The renewing attempt just learned it lost
+                            # the race — a state transition (→ revoked)
+                            # the conformance replay needs on the log.
+                            self.report.record_revocation(
+                                "map" if ph is self.map else "reduce",
+                                params[0],
+                                wid=params[1] if len(params) > 1 else None,
+                            )
                 writer.write(json.dumps(resp).encode() + b"\n")
                 await writer.drain()
         except (ConnectionResetError, asyncio.IncompleteReadError, json.JSONDecodeError):
@@ -664,13 +717,25 @@ class Coordinator:
                 stop_tracing()
             from mapreduce_rust_tpu.runtime.telemetry import flush_run_artifacts
 
-            flush_run_artifacts(
-                self.cfg, tracer, tag="coord", logger=log,
-                extra={
-                    "kind": "coordinator_manifest",
-                    "job_report": self.report.to_dict(),
-                },
-            )
+            # Snapshot ON the loop thread: straggler workers are still
+            # polling this loop, and their handlers mutate the report —
+            # to_dict() here is serialized with them; on the pool thread
+            # it would race a late deregister/record and tear the
+            # manifest (or die mid-iteration on a dict resize).
+            extra = {
+                "kind": "coordinator_manifest",
+                "job_report": self.report.to_dict(),
+            }
+
+            def _flush() -> None:
+                flush_run_artifacts(self.cfg, tracer, tag="coord",
+                                    logger=log, extra=extra)
+
+            # Only the I/O leaves the loop: the flush shells out to git
+            # (git_rev) and writes files, and a blocked loop here reads
+            # as a wedged coordinator to the pollers
+            # (mrlint: blocking-in-async).
+            await asyncio.get_running_loop().run_in_executor(None, _flush)
             server.close()
             await server.wait_closed()
 
@@ -744,6 +809,21 @@ class CoordinatorClient:
         assert self._writer is not None, "connect() first"
         self._next_id += 1
         req = {"id": self._next_id, "method": method, "params": list(params)}
+        # Happens-before bracket (only when this process traces): a
+        # globally unique call id links the client's send/recv instants to
+        # the coordinator's rpc span, giving mrcheck the two HB edges an
+        # RPC defines — send ≤ handle and handle ≤ recv. Instants, not
+        # spans: several asyncio tasks (task loop + renewal loop) await
+        # calls on ONE thread, and interleaved spans would partially
+        # overlap, which validate_events rejects.
+        cid = None
+        if active_tracer() is not None:
+            # Process-global counter, not per-client: renewal clients are
+            # created per task and a freed client's successor must never
+            # mint the same id (a collided cid would fabricate HB edges).
+            cid = f"{_rpc_run}:{next(_rpc_cid)}"
+            req["cid"] = cid
+            trace_instant("rpc.send", cid=cid, method=method)
         t0 = time.perf_counter()
         self._writer.write(json.dumps(req).encode() + b"\n")
         try:
@@ -763,6 +843,10 @@ class CoordinatorClient:
         t1 = time.perf_counter()
         if not line:
             raise ConnectionResetError("coordinator closed")
+        if cid is not None:
+            # After the response is in hand: everything the handler did
+            # (journal append, report mutation) happens-before this point.
+            trace_instant("rpc.recv", cid=cid, method=method)
         resp = json.loads(line)
         if "error" in resp:
             raise RuntimeError(resp["error"])

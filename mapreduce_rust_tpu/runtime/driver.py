@@ -161,6 +161,16 @@ def _sample_device_memory(stats) -> None:
         return
     _mem_last_sample[0] = now
     try:
+        from jax._src import xla_bridge
+
+        if not xla_bridge._backends:
+            # No backend initialized in this process: local_devices()
+            # would CREATE one — a ~minutes metadata probe against an
+            # absent accelerator (the PR 6 worker wedge). The gauge is
+            # guarded at the source now, not just at one caller, so every
+            # present and future call site inherits the safety
+            # (mrlint: backend-init-in-probe).
+            return
         for i, dev in enumerate(jax.local_devices()):
             ms = dev.memory_stats()
             if not ms:

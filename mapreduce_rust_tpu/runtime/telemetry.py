@@ -47,9 +47,26 @@ class JobReport:
     aggregate-counters doctrine of runtime/metrics.py.
     """
 
+    #: Ordered-event-log cap: the log exists for mrcheck's state-machine
+    #: replay, and state-CHANGING events (grants, expiries, finishes,
+    #: revocations — never renewals) are bounded by task count × attempts,
+    #: so a real job sits far under this. The cap is a backstop against a
+    #: pathological grant storm turning the report into the hot path;
+    #: overflow is counted, never silent.
+    EVENT_CAP = 20000
+
     def __init__(self) -> None:
         self._tasks: dict[tuple[str, int], dict] = {}
         self._rpc: dict[str, Histogram] = {}
+        # The ordered control-plane event log (mrcheck's replay substrate):
+        # one row per STATE TRANSITION of the lease/attempt machine —
+        # grant/speculate/expire/finish/late_finish/revoke/deregister, each
+        # with (t, phase, tid, attempt, wid). Renewals are deliberately NOT
+        # logged (renewed* is unbounded and extends a lease without
+        # changing its state), so the log stays O(tasks), in keeping with
+        # the aggregate-counters doctrine.
+        self._events: list[dict] = []
+        self._events_dropped = 0
         # Per-worker attribution (ISSUE 5 satellite — the PR 4 leftover):
         # wid → counters + an attempt-duration histogram. Grants, renewals
         # and finish reports carry the worker id, so `watch` shows a
@@ -97,6 +114,28 @@ class JobReport:
             }
         return w
 
+    def record_event(self, ev: str, phase=None, tid=None, attempt=None,
+                     wid=None) -> None:
+        """Append one state-transition row to the ordered event log. The
+        wall-clock context (``t``, seconds since this report's epoch) is
+        what mrcheck prints next to an offending event pair."""
+        if len(self._events) >= self.EVENT_CAP:
+            self._events_dropped += 1
+            return
+        row: dict = {"t": round(time.monotonic() - self._t0, 6), "ev": ev}
+        if phase is not None:
+            row["phase"] = phase
+        if tid is not None:
+            row["tid"] = tid
+        if attempt is not None:
+            row["attempt"] = attempt
+        if wid is not None and not (isinstance(wid, int) and wid < 0):
+            row["wid"] = wid
+        self._events.append(row)
+
+    def events(self) -> list[dict]:
+        return list(self._events)
+
     def attempts(self, phase: str, tid: int) -> int:
         """How many times (phase, tid) has been granted — the attempt
         number of the CURRENT grant, and the suffix of its flow id."""
@@ -126,6 +165,20 @@ class JobReport:
         adds the speculation accounting on top."""
         self._task(phase, tid)["speculations"] += 1
         self._spec_phase(phase)["attempts"] += 1
+        # Logged BEFORE the grant it arms: the replay reads "speculate then
+        # grant" as one lease-SHARING attempt, not a grant-over-live-lease.
+        self.record_event("speculate", phase, tid,
+                          attempt=self.attempts(phase, tid) + 1, wid=wid)
+
+    def record_revocation(self, phase: str, tid: int, wid=None) -> None:
+        """A renewal was answered revoked=True: the renewing attempt lost
+        a speculation race (the task is already reported). State-changing
+        for that attempt (→ revoked), so it is logged."""
+        self.record_event("revoke", phase, tid, wid=wid)
+
+    def record_deregister(self, wid) -> None:
+        """Graceful drain: the wid must never be granted again."""
+        self.record_event("deregister", wid=wid)
 
     def record_speculation_result(self, phase: str, won: bool,
                                   time_saved_s: float = 0.0) -> None:
@@ -157,7 +210,12 @@ class JobReport:
     def uptime_s(self) -> float:
         return time.monotonic() - self._t0
 
-    def record_grant(self, phase: str, tid: int, wid=None) -> None:
+    def record_grant(self, phase: str, tid: int, wid=None,
+                     attempt=None) -> None:
+        # ``attempt`` overrides the local grant count on the event row: a
+        # worker's side of the log must carry the COORDINATOR's attempt
+        # number (a re-execution grant arrives as attempt 2 even though it
+        # is this worker's first grant of the tid).
         t = self._task(phase, tid)
         t["grants"] += 1
         now = time.monotonic() - self._t0
@@ -169,6 +227,8 @@ class JobReport:
         w = self._worker(wid)
         if w is not None:
             w["grants"] += 1
+        self.record_event("grant", phase, tid,
+                          attempt=attempt or t["grants"], wid=wid)
 
     def record_renewal(self, phase: str, tid: int, ok: bool, wid=None) -> None:
         # Update-only: a renewal for a task this incarnation never granted
@@ -182,10 +242,12 @@ class JobReport:
             w["renewals" if ok else "stale_renewals"] += 1
 
     def record_expiry(self, phase: str, tid: int) -> None:
-        self._task(phase, tid)["expiries"] += 1
+        t = self._task(phase, tid)
+        t["expiries"] += 1
+        self.record_event("expire", phase, tid, attempt=t["grants"])
 
     def record_finish(self, phase: str, tid: int, late: bool = False,
-                      wid=None) -> None:
+                      wid=None, attempt=None) -> None:
         # Update-only, like record_renewal: a finish report for a task this
         # incarnation never granted (journal-resume restart) must not
         # fabricate a completed-but-never-granted entry whose duration_s
@@ -193,6 +255,8 @@ class JobReport:
         t = self._tasks.get((phase, tid))
         if t is None:
             return
+        self.record_event("late_finish" if late else "finish", phase, tid,
+                          attempt=attempt, wid=wid)
         w = self._worker(wid)
         if late:
             # A duplicate completion (original + re-executed worker both
@@ -311,7 +375,10 @@ class JobReport:
             }
             for m, h in sorted(self._rpc.items())
         }
-        out = {"tasks": phases, "totals": totals, "rpc": rpc}
+        out = {"tasks": phases, "totals": totals, "rpc": rpc,
+               "events": self.events()}
+        if self._events_dropped:
+            out["events_dropped"] = self._events_dropped
         if self._workers:
             out["workers"] = self.workers_summary()
         return out
@@ -450,11 +517,20 @@ def platform_info() -> dict:
     if jax is not None:
         info["jax"] = jax.__version__
         try:
+            from jax._src import xla_bridge
+
+            if not xla_bridge._backends:
+                # jax imported but no backend initialized: jax.devices()
+                # here would TRIGGER init — the exact wedge class the
+                # worker gauge hit in PR 6, hiding in a manifest flush. A
+                # manifest from such a process simply omits device
+                # identity (mrlint: backend-init-in-probe).
+                return info
             devs = jax.devices()
             info["backend"] = devs[0].platform
             info["device_count"] = len(devs)
             info["process_count"] = jax.process_count()
-        except Exception:  # backend init failed — manifest still writes
+        except Exception:  # backend probe failed — manifest still writes
             info["backend"] = "unavailable"
     return info
 
@@ -724,9 +800,10 @@ def diff_manifests(a: dict, b: dict) -> list[str]:
         if key.startswith(skip) or key in skip:
             continue
         # Raw histogram internals (sparse bucket maps, embedded hist
-        # copies): the percentile fields beside them carry the comparable
-        # signal; diffing bucket indexes is noise.
-        if any(seg in ("buckets", "hist") for seg in key.split(".")):
+        # copies) and the ordered event log (mrcheck's replay substrate —
+        # timestamps differ every run by construction): the aggregate
+        # fields beside them carry the comparable signal.
+        if any(seg in ("buckets", "hist", "events") for seg in key.split(".")):
             continue
         va, vb = fa.get(key, "<absent>"), fb.get(key, "<absent>")
         if va == vb:

@@ -696,6 +696,7 @@ def merge_traces(out_path: str, paths: "list[str]") -> dict:
     merged: list[dict] = []
     processes: list[dict] = []
     used_pids: set = set()
+    used_labels: set = set()
     for t in traces:
         md = t["md"]
         delta_s, domain = (0.0, "reference") if t is ref \
@@ -714,6 +715,21 @@ def merge_traces(out_path: str, paths: "list[str]") -> dict:
             remap[pid] = new
             used_pids.add(new)
         tag = md.get("tag") or os.path.splitext(os.path.basename(t["path"]))[0]
+        # Duplicate (pid, tag) metadata across input files (pid reuse on
+        # another host mints the same "w<pid>" tag; or one file fed in
+        # twice): the pids above were remapped apart, but two tracks with
+        # ONE name silently read as one process — remap the tag too, like
+        # the pid, so every track stays attributable. The partial flag is
+        # part of the identity: a final trace beside its own stale partial
+        # is the legitimate same-tag pair and keeps its bare name.
+        key = (tag, bool(md.get("partial")))
+        if key in used_labels:
+            n = 2
+            while (f"{tag}#{n}", key[1]) in used_labels:
+                n += 1
+            tag = f"{tag}#{n}"
+            key = (tag, key[1])
+        used_labels.add(key)
         label = f"{tag}{' [partial]' if md.get('partial') else ''}"
         for pid in sorted(remap.values(), key=str):
             merged.append({

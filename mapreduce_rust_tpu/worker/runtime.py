@@ -37,7 +37,6 @@ import signal as _signal
 import sys
 import threading
 import time
-import types
 import uuid
 
 import numpy as np
@@ -125,10 +124,16 @@ class Worker:
         # lost): their finish report is skipped — the winner already
         # journaled — and the manifest lists them.
         self.revoked_tasks: list[str] = []
-        # Device-memory high-water shim for _sample_device_memory (the
-        # worker has a JobReport, not a JobStats): worker manifests carry
-        # device.mem.d* gauges + device_mem_high_bytes too (PR 5 leftover).
-        self._mem = types.SimpleNamespace(device_mem_high_bytes=0)
+        # Worker-side data-plane stats (a real JobStats, sanitized under
+        # MR_SANITIZE — ISSUE 7 satellite; this replaced a SimpleNamespace
+        # shim): bytes mapped, per-task wall histogram, and the
+        # device-memory high water _sample_device_memory records. Written
+        # from the event-loop thread (memory samples between tasks) AND
+        # from executor pool threads (per-task accounting) — every one of
+        # which must register_writer first; see _execute_task.
+        from mapreduce_rust_tpu.analysis.sanitize import new_job_stats
+
+        self.stats = new_job_stats(cfg)
 
     @property
     def _wid(self) -> int:
@@ -140,6 +145,13 @@ class Worker:
         """Graceful drain: finish the current task, report it, deregister,
         exit 0. Thread- and signal-safe (a threading.Event, checked at
         task boundaries — never mid-compute). The CLI wires SIGTERM here."""
+        # The requester may be a signal handler or an embedding's watcher
+        # thread the stats object has never seen; the drain bookkeeping it
+        # triggers (final memory sample, manifest fields) must not trip
+        # the sanitizer's registered-writer gate (ISSUE 7 satellite: the
+        # drain path was an unregistered writer).
+        self.stats.register_writer()
+        trace_instant("worker.drain_requested")
         self._drain.set()
 
     def _chaos_pick(self, site: str, **ctx):
@@ -175,7 +187,7 @@ class Worker:
             return  # unknown jax layout: skip the gauge, never the task
         from mapreduce_rust_tpu.runtime.driver import _sample_device_memory
 
-        _sample_device_memory(self._mem)
+        _sample_device_memory(self.stats)
 
     # ---- map/reduce engines ----
 
@@ -298,6 +310,12 @@ class Worker:
 
     def _run_map_task(self, tid: int) -> None:
         path = self.inputs[tid]
+        # Data-plane accounting on the executor thread (the sanitizer's
+        # registered-writer gate covers this — _execute_task registered).
+        try:
+            self.stats.bytes_in += os.path.getsize(path)
+        except OSError:
+            pass
         table, dictionary = self._map_table(tid, path)
         self.work.mkdir(parents=True, exist_ok=True)
         op = self.app.combine_op
@@ -369,6 +387,23 @@ class Worker:
         log.info("reduce %d: %d keys → mr-%d.txt", tid, len(items), tid)
 
     # ---- task loop ----
+
+    def _execute_task(self, run_task, tid: int) -> None:
+        """Executor-thread task wrapper: per-task data-plane accounting +
+        the post-task device-memory sample, from the thread that just ran
+        the compute. The pool hands SPECULATIVE attempts to whatever
+        thread is free — often one the stats object has never seen — so
+        each task registers its own thread as a writer (ISSUE 7
+        satellite: the speculation fork was an unregistered writer under
+        MR_SANITIZE=1)."""
+        self.stats.register_writer()
+        t0 = time.perf_counter()
+        run_task(tid)
+        self.stats.record_hist("worker.task_s", time.perf_counter() - t0)
+        # Post-compute sample on THIS thread: the device engine's high
+        # water peaks during the task, which the between-task event-loop
+        # sample misses.
+        self._sample_memory()
 
     async def _call(self, client: CoordinatorClient, method: str, *params):
         """client.call with the round-trip latency recorded (client-observed:
@@ -512,10 +547,12 @@ class Worker:
                 await asyncio.sleep(poll.next_delay())
                 continue
             poll.reset()
-            self.report.record_grant(phase, tid, wid=self._wid)
             # The grant response carried the coordinator's attempt number:
-            # the task span joins that attempt's flow chain.
+            # the task span joins that attempt's flow chain, and the
+            # worker's own event log records the same attempt the
+            # coordinator's does (mrcheck reads either side uniformly).
             att = client.last_attempt or 1
+            self.report.record_grant(phase, tid, wid=self._wid, attempt=att)
             self._attempts[(phase, tid)] = att
             # Separate connection for renewals, like the reference's
             # spawned renewal task (mrworker.rs:70-94) — but paced.
@@ -532,7 +569,9 @@ class Worker:
             )
             try:
                 # Heavy compute off the event loop so renewals keep flowing.
-                await asyncio.get_running_loop().run_in_executor(None, run_task, tid)
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self._execute_task, run_task, tid
+                )
             finally:
                 # Flag first, then cancel: see _renewal_loop on why cancel
                 # alone can be swallowed mid-RPC on Python < 3.12.
@@ -582,10 +621,15 @@ class Worker:
                     log.info("%s %d: coordinator gone before finish report "
                              "— job complete, dropping it", phase, tid)
                     return False
-            self.report.record_finish(phase, tid, wid=self._wid)
+            self.report.record_finish(phase, tid, wid=self._wid,
+                                      attempt=self._attempts.get((phase, tid)))
             maybe_snapshot()
 
     async def run(self) -> None:
+        # The loop thread may not be the thread that CONSTRUCTED this
+        # worker (embedding harnesses run asyncio off-thread): its
+        # between-task memory samples write stats, so it registers.
+        self.stats.register_writer()
         # The worker honors Config.trace_path/manifest_path like the driver
         # does, under per-process names (several workers share one Config).
         tag = f"w{os.getpid()}"
@@ -646,7 +690,17 @@ class Worker:
                 "drained": self.drained,
                 # Worker-loop device-memory high water (PR 5 leftover; 0 on
                 # backends without memory_stats or when jax never loaded).
-                "device_mem_high_bytes": self._mem.device_mem_high_bytes,
+                "device_mem_high_bytes": self.stats.device_mem_high_bytes,
+                # Worker data-plane stats (ISSUE 7 satellite): bytes this
+                # worker mapped + its per-task wall histogram — written
+                # from registered executor threads only.
+                "worker_stats": {
+                    "bytes_in": self.stats.bytes_in,
+                    "task_s": {
+                        name: h.to_dict()
+                        for name, h in sorted(self.stats.hists.items())
+                    },
+                },
             }
             if self.revoked_tasks:
                 extra["revoked_tasks"] = self.revoked_tasks
@@ -658,7 +712,14 @@ class Worker:
                     "spec": self.chaos.spec,
                     "fired": self.chaos.fired(),
                 }
-            flush_run_artifacts(
-                self.cfg, tracer, tag=f"w{os.getpid()}", logger=log,
-                extra=extra,
-            )
+
+            def _flush() -> None:
+                flush_run_artifacts(
+                    self.cfg, tracer, tag=f"w{os.getpid()}", logger=log,
+                    extra=extra,
+                )
+
+            # Off the event loop (mrlint: blocking-in-async): the flush
+            # shells out to git and writes trace/manifest files — nothing
+            # else on this loop should stall behind teardown telemetry.
+            await asyncio.get_running_loop().run_in_executor(None, _flush)

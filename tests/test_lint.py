@@ -624,6 +624,12 @@ def test_baseline_requires_reasons(tmp_path):
         {"rule": "*", "path": "x.py", "reason": "because"},
     ]}))
     assert load_baseline(str(good))[0]["rule"] == "*"
+    # A bare-list baseline is a config error (exit 2 via run_cli), never
+    # an AttributeError traceback.
+    arr = tmp_path / "arr.json"
+    arr.write_text(json.dumps([{"rule": "x"}]))
+    with pytest.raises(ValueError, match="suppressions"):
+        load_baseline(str(arr))
 
 
 def test_json_report_schema(tmp_path):
@@ -657,3 +663,392 @@ def test_parse_error_is_a_finding_not_a_crash(tmp_path):
     report = lint_paths([str(p)])
     assert not report.ok
     assert [e.rule for e in report.parse_errors] == ["parse-error"]
+
+
+# ---------------------------------------------------------------------------
+# Interprocedural dataflow rules (ISSUE 7: the CFG/reaching-defs layer).
+# These run once over the whole file set via lint_paths — lint_file stays
+# per-file — so the fixtures drive lint_paths.
+# ---------------------------------------------------------------------------
+
+def program_rules_fired(tmp_path, src, name="snippet.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    report = lint_paths([str(p)])
+    assert not report.parse_errors, report.parse_errors
+    return sorted({f.rule for f in report.findings}), report
+
+
+def test_blocking_in_async_fires_on_direct_sleep(tmp_path):
+    fired, report = program_rules_fired(tmp_path, """
+        import time
+
+        async def renewal_loop():
+            time.sleep(1.0)      # starves every coroutine on the loop
+    """)
+    assert fired == ["blocking-in-async"]
+    assert "renewal_loop" in report.findings[0].message
+
+
+def test_blocking_in_async_follows_sync_helpers(tmp_path):
+    # The shipped-bug shape: the blocking call hides two frames down.
+    fired, report = program_rules_fired(tmp_path, """
+        import subprocess
+
+        def git_rev():
+            return subprocess.run(["git", "rev-parse", "HEAD"])
+
+        def flush_manifest():
+            return git_rev()
+
+        async def teardown():
+            flush_manifest()
+    """)
+    assert fired == ["blocking-in-async"]
+    msg = report.findings[0].message
+    assert "via" in msg and "flush_manifest" in msg and "git_rev" in msg
+
+
+def test_blocking_in_async_fires_on_from_import(tmp_path):
+    fired, _ = program_rules_fired(tmp_path, """
+        from time import sleep
+
+        async def poll():
+            sleep(0.1)
+    """)
+    assert fired == ["blocking-in-async"]
+
+
+def test_blocking_in_async_silent_on_executor_handoff(tmp_path):
+    # run_in_executor is the LEGAL boundary: the callable runs on a pool
+    # thread, exactly how blocking compute coexists with the event loop.
+    fired, _ = program_rules_fired(tmp_path, """
+        import asyncio
+        import time
+
+        def heavy_task(tid):
+            time.sleep(1.0)      # fine: pool thread, not the loop
+
+        async def task_loop():
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(None, heavy_task, 0)
+    """)
+    assert fired == []
+
+
+def test_blocking_in_async_silent_on_lambda_handoff(tmp_path):
+    # A lambda handed to the executor defers its WHOLE body to the pool
+    # thread — as legal as a bare callable reference.
+    fired, _ = program_rules_fired(tmp_path, """
+        import asyncio
+        import time
+
+        async def task_loop():
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(None, lambda: time.sleep(1.0))
+    """)
+    assert fired == []
+
+
+def test_blocking_in_async_fires_on_eager_call_argument(tmp_path):
+    # submit(build_payload()) runs build_payload on the CALLER's thread —
+    # the handoff only ships its return value; the blocking call still
+    # lands on the event loop.
+    fired, report = program_rules_fired(tmp_path, """
+        import subprocess
+
+        def build_payload():
+            return subprocess.run(["tar", "c", "."])
+
+        async def ship(pool):
+            pool.submit(build_payload())
+    """)
+    assert fired == ["blocking-in-async"]
+    assert "build_payload" in report.findings[0].message
+
+
+def test_blocking_in_async_silent_on_async_sleep_and_sync_only(tmp_path):
+    fired, _ = program_rules_fired(tmp_path, """
+        import asyncio
+        import time
+
+        async def poll():
+            await asyncio.sleep(0.1)
+
+        def sync_only():
+            time.sleep(1.0)      # never reached from an async def
+    """)
+    assert fired == []
+
+
+def test_backend_init_in_probe_fires_unguarded(tmp_path):
+    fired, report = program_rules_fired(tmp_path, """
+        import jax
+
+        def sample_device_memory(stats):
+            for dev in jax.local_devices():   # triggers backend init
+                stats.high = dev.memory_stats()
+    """)
+    assert fired == ["backend-init-in-probe"]
+    assert "_backends" in report.findings[0].message
+
+
+def test_backend_init_in_probe_fires_through_helper(tmp_path):
+    fired, report = program_rules_fired(tmp_path, """
+        import jax
+
+        def _grab():
+            return jax.local_devices()
+
+        def platform_info():
+            return _grab()
+    """)
+    assert fired == ["backend-init-in-probe"]
+    assert "platform_info" in report.findings[0].message
+
+
+def test_backend_init_in_probe_silent_with_guard(tmp_path):
+    # The shipped fix (PR 6 worker wedge): the _backends early-exit
+    # dominates the device call — including inside try/except, which is
+    # where the driver's gauge lives.
+    fired, _ = program_rules_fired(tmp_path, """
+        import jax
+
+        def sample_device_memory(stats):
+            try:
+                from jax._src import xla_bridge
+
+                if not xla_bridge._backends:
+                    return
+                for dev in jax.local_devices():
+                    stats.high = dev.memory_stats()
+            except Exception:
+                pass
+    """)
+    assert fired == []
+
+
+def test_backend_init_in_probe_silent_when_guarded_at_call_site(tmp_path):
+    # The probe checks BEFORE descending into the helper: the hop is
+    # covered even though the helper itself has no guard.
+    fired, _ = program_rules_fired(tmp_path, """
+        import jax
+
+        def _grab():
+            return jax.local_devices()
+
+        def sample_memory():
+            from jax._src import xla_bridge
+
+            if not xla_bridge._backends:
+                return None
+            return _grab()
+    """)
+    assert fired == []
+
+
+def test_backend_init_in_probe_ignores_non_probe_functions(tmp_path):
+    # Device access outside the telemetry naming convention is the data
+    # plane's business (it WANTS backend init), not this rule's.
+    fired, _ = program_rules_fired(tmp_path, """
+        import jax
+
+        def run_job():
+            return jax.devices()
+    """)
+    assert fired == []
+
+
+def test_nondeterministic_partition_fires_on_set_into_shard_index(tmp_path):
+    fired, report = program_rules_fired(tmp_path, """
+        def partition(words, reduce_n, out):
+            seen = set(words)
+            for w in seen:                      # hash-randomized order
+                out[hash(w) % reduce_n].append(w)
+    """)
+    assert fired == ["nondeterministic-partition-input"]
+    assert "sorted" in report.findings[0].message
+
+
+def test_nondeterministic_partition_follows_aliases(tmp_path):
+    # The reaching-defs chain: an alias must not hide the set.
+    fired, _ = program_rules_fired(tmp_path, """
+        def partition(words, reduce_n, out):
+            seen = {w for w in words}
+            pending = seen
+            for w in pending:
+                out[hash(w) % reduce_n].append(w)
+    """)
+    assert fired == ["nondeterministic-partition-input"]
+
+
+def test_nondeterministic_partition_silent_on_sorted_and_dicts(tmp_path):
+    fired, _ = program_rules_fired(tmp_path, """
+        def partition(words, reduce_n, out):
+            seen = set(words)
+            for w in sorted(seen):              # the shipped pattern
+                out[hash(w) % reduce_n].append(w)
+
+        def dict_partition(counts, reduce_n, out):
+            for w in counts:                    # insertion-ordered
+                out[hash(w) % reduce_n].append(w)
+    """)
+    assert fired == []
+
+
+def test_nondeterministic_partition_silent_off_the_partition_path(tmp_path):
+    # Unordered iteration is fine when no shard/partition index depends
+    # on the order.
+    fired, _ = program_rules_fired(tmp_path, """
+        def count(words):
+            total = 0
+            for w in set(words):
+                total += 1
+            return total
+    """)
+    assert fired == []
+
+
+def test_program_rule_findings_obey_inline_ignores(tmp_path):
+    _, report = program_rules_fired(tmp_path, """
+        import time
+
+        async def poll():
+            time.sleep(0.1)  # mrlint: ignore[blocking-in-async] -- fixture
+    """)
+    assert report.findings == [] and report.suppressed == 1
+
+
+def test_strict_baseline_promotes_unused_entries(tmp_path, capsys):
+    from mapreduce_rust_tpu.__main__ import main
+
+    clean = tmp_path / "clean.py"
+    clean.write_text("X = 1\n")
+    baseline = tmp_path / ".mrlint.json"
+    baseline.write_text(json.dumps({"suppressions": [
+        {"rule": "jit-in-loop", "path": "*gone.py",
+         "reason": "stale suppression nothing matches"},
+    ]}))
+    # Default: a warning only — the lint itself is clean.
+    assert main(["lint", str(clean), "--baseline", str(baseline)]) == 0
+    capsys.readouterr()
+    # --strict-baseline: the stale entry IS the failure (it would swallow
+    # a real finding at that path tomorrow).
+    assert main(["lint", str(clean), "--baseline", str(baseline),
+                 "--strict-baseline"]) == 1
+    assert "unused baseline" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# Dataflow layer units (analysis/dataflow.py)
+# ---------------------------------------------------------------------------
+
+def _program(src):
+    import ast as _ast
+
+    from mapreduce_rust_tpu.analysis.dataflow import Program
+    from mapreduce_rust_tpu.analysis.lint import attach_parents
+
+    tree = _ast.parse(textwrap.dedent(src))
+    attach_parents(tree)
+    return Program([("snippet.py", tree)])
+
+
+def test_dataflow_guarded_reach_branch_sensitivity():
+    import ast as _ast
+
+    from mapreduce_rust_tpu.analysis.dataflow import guarded_reach
+
+    prog = _program("""
+        def guarded(b):
+            if not b._backends:
+                return
+            b.probe()
+
+        def unguarded(b):
+            if b.other:
+                pass
+            b.probe()
+
+        def wrong_branch(b):
+            if b._backends:
+                return          # inverted: present means BAIL
+            b.probe()
+    """)
+    for fu in prog.functions:
+        call = next(
+            n for n in _ast.walk(fu.node)
+            if isinstance(n, _ast.Call) and n.func.attr == "probe"
+        )
+        assert guarded_reach(fu.cfg, call, "_backends") is (
+            fu.name == "guarded"
+        ), fu.name
+
+
+def test_dataflow_origins_follow_copy_chains():
+    import ast as _ast
+
+    from mapreduce_rust_tpu.analysis.dataflow import origins
+
+    prog = _program("""
+        def f(xs):
+            a = set(xs)
+            b = a
+            for w in b:
+                pass
+    """)
+    fu = prog.functions[0]
+    loop = next(n for n in _ast.walk(fu.node) if isinstance(n, _ast.For))
+    defs, reach = fu.rd
+    (origin,) = origins(fu.cfg, defs, reach, loop.iter)
+    assert isinstance(origin, _ast.Call) and origin.func.id == "set"
+
+
+def test_dataflow_call_graph_excludes_executor_handoffs():
+    prog = _program("""
+        def work():
+            pass
+
+        def direct():
+            work()
+
+        def handoff(pool):
+            pool.submit(work)
+    """)
+    by = {fu.name: fu for fu in prog.functions}
+    assert [t.name for _c, t in prog.callees(by["direct"]) if t] == ["work"]
+    assert [t for _c, t in prog.callees(by["handoff"])] == [None]
+
+
+def test_dataflow_resolve_prefers_same_class_then_is_conservative():
+    prog = _program("""
+        class A:
+            def helper(self):
+                pass
+
+            def go(self):
+                self.helper()
+
+        class B:
+            def helper(self):
+                pass
+    """)
+    go = next(fu for fu in prog.functions if fu.name == "go")
+    (call, target), = [(c, t) for c, t in prog.callees(go)]
+    assert target is not None and target.qualname == "A.helper"
+    # A bare ambiguous name (A.helper vs B.helper, neither preferred by
+    # the self. heuristic) resolves to no edge: precision over recall.
+    prog2 = _program("""
+        class A:
+            def helper(self):
+                pass
+
+        class B:
+            def helper(self):
+                pass
+
+        def go():
+            helper()
+    """)
+    go2 = next(fu for fu in prog2.functions if fu.name == "go")
+    assert [t for _c, t in prog2.callees(go2)] == [None]

@@ -243,3 +243,94 @@ def test_fast_subset_of_suite_passes_under_mr_sanitize():
         capture_output=True, text=True, timeout=600, cwd=REPO, env=env,
     )
     assert r.returncode == 0, (r.stdout[-3000:], r.stderr[-1000:])
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 7 satellite: the speculation fork and the SIGTERM drain path are
+# registered writers on the worker's SanitizedJobStats
+# ---------------------------------------------------------------------------
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_drain_request_registers_its_thread(tmp_path, monkeypatch):
+    # SIGTERM lands on a signal-handler frame (or an embedder's watcher
+    # thread) the stats object has never seen; the drain bookkeeping it
+    # triggers must not trip the registered-writer gate.
+    from mapreduce_rust_tpu.worker.runtime import Worker
+
+    monkeypatch.setenv("MR_SANITIZE", "1")
+    _write_corpus(tmp_path)
+    cfg = Config(
+        map_n=len(TEXTS), reduce_n=2, worker_n=1, port=_free_port(),
+        input_dir=str(tmp_path / "in"), work_dir=str(tmp_path / "work"),
+        output_dir=str(tmp_path / "out"),
+    )
+    w = Worker(cfg, engine="host")
+    assert type(w.stats) is SanitizedJobStats
+
+    def drain_then_write():
+        w.request_drain()
+        # The drain path's bookkeeping writes (final memory sample,
+        # manifest fields) come from this same foreign thread.
+        w.stats.device_mem_high_bytes = 123
+
+    assert _run_in_thread(drain_then_write) is None
+    assert w._drain.is_set() and w.stats.device_mem_high_bytes == 123
+
+
+def test_speculation_race_exact_under_sanitizer(tmp_path, monkeypatch):
+    """The REAL speculation race under MR_SANITIZE=1: a straggler pause
+    makes the coordinator re-issue the slow task to the idle worker, so a
+    speculative attempt lands on whatever executor thread is free — often
+    one the worker's SanitizedJobStats has never seen. Pre-ISSUE 7 that
+    thread never registered and the race only passed unsanitized; now
+    every task execution registers itself (Worker._execute_task) and the
+    run must stay exact with zero sanitizer trips."""
+    import asyncio
+
+    from mapreduce_rust_tpu.coordinator.server import Coordinator
+    from mapreduce_rust_tpu.worker.runtime import Worker
+
+    monkeypatch.setenv("MR_SANITIZE", "1")
+    _write_corpus(tmp_path)
+    cfg = Config(
+        map_n=len(TEXTS), reduce_n=2, worker_n=2, chunk_bytes=4096,
+        port=_free_port(),
+        # Lease LONGER than the pause: recovery must come from the
+        # speculative attempt, not lease expiry (test_chaos's race).
+        lease_timeout_s=6.0, lease_check_period_s=0.2,
+        lease_renew_period_s=0.2, poll_retry_s=0.05,
+        speculate=True, speculate_after_frac=0.5,
+        input_dir=str(tmp_path / "in"), work_dir=str(tmp_path / "work"),
+        output_dir=str(tmp_path / "out"),
+    )
+    chaos_cfg = dataclasses.replace(cfg, chaos="pause:map:0:2.0")
+
+    async def cluster():
+        coord = Coordinator(cfg)
+        serve = asyncio.create_task(coord.serve())
+        await asyncio.sleep(0.1)
+        ws = [Worker(chaos_cfg, engine="host"), Worker(cfg, engine="host")]
+        workers = asyncio.gather(*(w.run() for w in ws))
+        await asyncio.wait_for(serve, timeout=60)
+        await asyncio.wait_for(workers, timeout=60)
+        return coord, ws
+
+    coord, ws = asyncio.run(cluster())
+    assert all(type(w.stats) is SanitizedJobStats for w in ws)
+    # The race actually ran: a speculative attempt was issued and won.
+    spec = coord.stats()["totals"]["map"]["speculation"]
+    assert spec["attempts"] >= 1
+    # Results exact — the sanitizer proved the fork clean, not just alive.
+    table = {}
+    for p in sorted((tmp_path / "out").glob("mr-*.txt")):
+        for line in p.read_bytes().splitlines():
+            word, v = line.rsplit(b" ", 1)
+            table[word] = int(v)
+    assert table == _oracle()

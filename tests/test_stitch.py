@@ -367,6 +367,41 @@ def test_merge_remaps_colliding_pids(tmp_path):
     assert len({e["pid"] for e in events if e["ph"] == "X"}) == 2
 
 
+def test_merge_remaps_duplicate_pid_tag_tracks(tmp_path):
+    # Pid reuse on another host mints the SAME "w<pid>" tag for a
+    # different process (or one file is fed in twice): the pids are
+    # remapped apart, but two tracks with one name silently read as one
+    # process. The merge disambiguates the duplicate tag like a pid
+    # collision (ISSUE 7 satellite).
+    evs_a = [{"name": "a", "ph": "X", "ts": 0.0, "dur": 1.0, "pid": 7, "tid": 1}]
+    evs_b = [{"name": "b", "ph": "X", "ts": 0.0, "dur": 1.0, "pid": 7, "tid": 1}]
+    a = _fake_trace(tmp_path / "a.json", 7, "w7", 1000.0, evs_a)
+    b = _fake_trace(tmp_path / "b.json", 7, "w7", 1000.5, evs_b)
+    out = tmp_path / "m.json"
+    summary = merge_traces(str(out), [a, b])
+    tags = [p["tag"] for p in summary["processes"]]
+    assert len(set(tags)) == 2 and "w7" in tags and "w7#2" in tags
+    events, _ = load_trace(str(out))
+    names = {
+        e["pid"]: e["args"]["name"] for e in events
+        if e.get("ph") == "M" and e["name"] == "process_name"
+    }
+    assert sorted(names.values()) == ["w7", "w7#2"]
+    # A final trace beside its OWN stale partial is the legitimate
+    # same-tag pair: the partial suffix already distinguishes the tracks,
+    # so neither name is mangled.
+    c = _fake_trace(tmp_path / "c.json", 7, "w7", 1000.0, evs_b,
+                    partial=True)
+    summary = merge_traces(str(tmp_path / "m2.json"), [a, c])
+    assert sorted(p["tag"] for p in summary["processes"]) == ["w7", "w7"]
+    events, _ = load_trace(str(tmp_path / "m2.json"))
+    labels = sorted(
+        e["args"]["name"] for e in events
+        if e.get("ph") == "M" and e["name"] == "process_name"
+    )
+    assert labels == ["w7", "w7 [partial]"]
+
+
 def test_merge_clamps_sub_rtt_flow_inversion(tmp_path):
     # The rebase is only accurate to ±RTT/2: a worker's task step can land
     # a few hundred µs BEFORE the coordinator's grant after rebasing. The
