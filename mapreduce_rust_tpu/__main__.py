@@ -72,6 +72,20 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="write the machine-readable run manifest (config, "
                    "platform, git rev, JobStats, phase times, trace path); "
                    "inspect/diff with the `stats` subcommand")
+    p.add_argument("--no-metrics", action="store_true", dest="no_metrics",
+                   help="disable the live metrics registry/time-series "
+                   "ring (runtime/metrics.py); on by default — sampled "
+                   "from existing loops, never per record")
+    p.add_argument("--metrics-period", type=float, default=1.0,
+                   dest="metrics_period", metavar="SECONDS",
+                   help="wall-clock bucket width of the live time-series "
+                   "ring (default 1.0s; the ring keeps the newest "
+                   "--metrics-ring points)")
+    p.add_argument("--metrics-ring", type=int, default=512,
+                   dest="metrics_ring", metavar="POINTS",
+                   help="time-series ring capacity (default 512 — ~8.5 "
+                   "min at the 1 Hz default; raise it or the period for "
+                   "long jobs, oldest points are evicted and counted)")
     p.add_argument("--sanitize", action="store_true",
                    help="thread-ownership sanitizer: cross-thread writes to "
                    "JobStats/the egress dictionary and scan-arena aliasing "
@@ -130,6 +144,10 @@ def _cfg(args, map_n: int = 1, worker_n: int = 1) -> Config:
         poll_retry_s=getattr(args, "poll_retry", 1.0),
         speculate=getattr(args, "speculate", False),
         speculate_after_frac=getattr(args, "speculate_after_frac", 0.75),
+        metrics_enabled=not getattr(args, "no_metrics", False),
+        metrics_sample_period_s=getattr(args, "metrics_period", 1.0) or 1.0,
+        metrics_ring_points=getattr(args, "metrics_ring", 512) or 512,
+        metrics_port=getattr(args, "metrics_port", 0) or 0,
         chaos=chaos,
         input_dir=args.input,
         input_pattern=args.pattern,
@@ -325,7 +343,8 @@ def cmd_trace(args) -> int:
     import json
 
     try:
-        summary = merge_traces(args.out, args.traces)
+        summary = merge_traces(args.out, args.traces,
+                               out_format=getattr(args, "format", "json"))
     except (OSError, ValueError, KeyError, json.JSONDecodeError) as e:
         print(f"trace merge: {e}", file=sys.stderr)
         return 1
@@ -345,7 +364,14 @@ def cmd_trace(args) -> int:
 def cmd_watch(args) -> int:
     """Live plain-text job view: polls the coordinator's ``stats`` RPC at
     ``--interval`` (default 1 Hz) and repaints per-phase progress + lease
-    liveness until the job completes or the coordinator goes away."""
+    liveness until the job completes or the coordinator goes away.
+    ``--doctor`` adds the streaming doctor's live findings + fleet
+    samples (the ``metrics`` RPC); ``--json`` streams one machine-readable
+    NDJSON object per poll instead of the TUI (``--once --json`` is the
+    scripting form: one object, exit)."""
+    import json
+    import time as _time
+
     from mapreduce_rust_tpu.coordinator.server import CoordinatorClient, RpcTimeout
     from mapreduce_rust_tpu.runtime.telemetry import format_progress
 
@@ -359,11 +385,16 @@ def cmd_watch(args) -> int:
             print(f"watch: no coordinator at {args.host}:{args.port} ({e})",
                   file=sys.stderr)
             return 1
-        clear = sys.stdout.isatty() and not args.once
+        as_json = getattr(args, "json", False)
+        clear = sys.stdout.isatty() and not args.once and not as_json
         try:
             while True:
                 try:
                     rep = await client.call("stats")
+                    live = (
+                        await client.call("metrics")
+                        if getattr(args, "doctor", False) else None
+                    )
                 except RpcTimeout as e:
                     # Alive-but-not-answering is the wedge this PR's whole
                     # timeout machinery exists to expose — it must never
@@ -371,11 +402,33 @@ def cmd_watch(args) -> int:
                     print(f"watch: coordinator not answering — wedged? ({e})",
                           file=sys.stderr)
                     return 1
-                except ConnectionError:
+                except (ConnectionError, RuntimeError) as e:
+                    if isinstance(e, RuntimeError):
+                        if "unknown method" not in str(e):
+                            raise
+                        # --doctor against a pre-metrics coordinator:
+                        # degrade to the plain view, loudly once.
+                        print("watch: coordinator predates the metrics RPC "
+                              "— --doctor unavailable", file=sys.stderr)
+                        args.doctor = False
+                        continue
                     print("watch: coordinator gone — job finished or stopped")
                     return 0
-                text = format_progress(rep)
-                print(("\x1b[H\x1b[2J" + text) if clear else text, flush=True)
+                if as_json:
+                    # One NDJSON object per poll: everything the TUI
+                    # renders, machine-readable for external tooling.
+                    row = {"t": round(_time.time(), 3), "stats": rep}
+                    if live is not None:
+                        row["metrics"] = live
+                    print(json.dumps(row, sort_keys=True), flush=True)
+                else:
+                    text = format_progress(rep)
+                    if live is not None:
+                        from mapreduce_rust_tpu.analysis.doctor import format_live
+
+                        text += "\n" + format_live(live, rep)
+                    print(("\x1b[H\x1b[2J" + text) if clear else text,
+                          flush=True)
                 if args.once or (rep.get("progress") or {}).get("done"):
                     return 0
                 await asyncio.sleep(args.interval)
@@ -474,6 +527,12 @@ def main(argv: list[str] | None = None) -> int:
     p = sub.add_parser("coordinator", help="control-plane scheduler")
     _add_common(p)
     p.add_argument("--worker-n", type=int, default=1)
+    p.add_argument("--metrics-port", type=int, default=0, dest="metrics_port",
+                   help="serve Prometheus text exposition (GET /metrics) "
+                   "on this port from a dedicated thread — standard "
+                   "scrapers work against a long-lived coordinator; the "
+                   "series are the same ones the run manifest keeps as "
+                   "stats.timeseries. 0 (default) = off")
     p.add_argument("--speculate", action="store_true",
                    help="speculative re-execution: near phase end, re-issue "
                    "the slowest in-flight task to an idle worker as a new "
@@ -564,9 +623,18 @@ def main(argv: list[str] | None = None) -> int:
         help="automated run diagnosis: bottleneck attribution, latency "
         "percentiles, skew/straggler/lease findings, regression gate",
     )
-    p.add_argument("manifest", help="run (or coordinator/bench) manifest to "
+    p.add_argument("manifest", nargs="?", default=None,
+                   help="run (or coordinator/bench) manifest to "
                    "diagnose — or the literal 'trend' to analyze a bench "
-                   "history for sustained drift")
+                   "history for sustained drift (omit with --live)")
+    p.add_argument("--live", default=None, metavar="HOST:PORT",
+                   help="streaming doctor against a RUNNING coordinator: "
+                   "poll its stats+metrics RPCs and print findings as "
+                   "they first appear, until the job completes")
+    p.add_argument("--interval", type=float, default=1.0,
+                   help="--live poll period in seconds (default 1.0)")
+    p.add_argument("--once", action="store_true",
+                   help="--live: print one snapshot and exit")
     p.add_argument("history", nargs="?", default=None,
                    help="with 'trend': the history file (default "
                    ".bench/history.jsonl) — exit 1 on sustained drift of a "
@@ -608,6 +676,12 @@ def main(argv: list[str] | None = None) -> int:
                    help="merge: stitch trace files (partials included) onto "
                    "the coordinator clock and write one Perfetto-loadable "
                    "timeline")
+    p.add_argument("--format", choices=["json", "perfetto"], default="json",
+                   dest="format",
+                   help="json (default): Chrome trace-event JSON; "
+                   "perfetto: binary track_event protobuf (.pftrace, "
+                   "hand-rolled varint writer, no deps) — for >100 MB "
+                   "timelines the JSON loader chokes on")
     p.add_argument("out", help="output path for the merged trace")
     p.add_argument("traces", nargs="+",
                    help="per-process trace files (trace-coord.json, "
@@ -624,7 +698,18 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--interval", type=float, default=1.0,
                    help="poll period in seconds (default 1 Hz)")
     p.add_argument("--once", action="store_true",
-                   help="print one snapshot and exit (scripting/tests)")
+                   help="print one snapshot and exit (scripting/tests); "
+                   "--once --json is the scripting form: one "
+                   "machine-readable object on stdout, exit 0")
+    p.add_argument("--json", action="store_true",
+                   help="stream one NDJSON object per poll ({t, stats"
+                   "[, metrics]}) instead of the TUI — external tooling "
+                   "consumes exactly what the TUI shows")
+    p.add_argument("--doctor", action="store_true",
+                   help="streaming doctor: append the coordinator's live "
+                   "findings (straggler, lease advice, skew, bottleneck "
+                   "attribution — with first-seen timestamps) and the "
+                   "fleet's renewal-envelope samples to every poll")
     p.add_argument("--connect-retries", type=int, default=5,
                    dest="connect_retries")
     p.add_argument("-v", "--verbose", action="store_true")

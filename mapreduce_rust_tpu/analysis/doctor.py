@@ -198,8 +198,16 @@ def diagnose(manifest: dict, job_report: "dict | None" = None,
     a partial diagnosis plus findings, never an exception."""
     findings: list[dict] = []
 
-    def find(severity: str, code: str, message: str) -> None:
-        findings.append({"severity": severity, "code": code, "message": message})
+    def find(severity: str, code: str, message: str,
+             key: "str | None" = None) -> None:
+        """``key`` is the finding's stable identity across re-evaluations
+        (defaults to the code): the streaming doctor dedups on it, so a
+        straggler's p50 drifting between ticks updates ONE finding with
+        one first-seen timestamp instead of minting a new row per tick."""
+        f = {"severity": severity, "code": code, "message": message}
+        if key is not None:
+            f["key"] = key
+        findings.append(f)
 
     stats = manifest.get("stats") or {}
     report = job_report if job_report is not None \
@@ -325,7 +333,8 @@ def diagnose(manifest: dict, job_report: "dict | None" = None,
             find("warn", "straggler",
                  f"worker {wid}: task p50 {p50s[wid]:.3f}s exceeds "
                  f"{straggler_factor:.1f}x the fleet median — a slow host, "
-                 "an oversubscribed core, or skewed inputs")
+                 "an oversubscribed core, or skewed inputs",
+                 key=f"straggler:w{wid}")
 
     # ---- speculation effectiveness (ISSUE 6) ----
     if report:
@@ -448,6 +457,180 @@ def diagnose(manifest: dict, job_report: "dict | None" = None,
 
 
 # ---------------------------------------------------------------------------
+# Streaming doctor (ISSUE 8): the same finding catalog, evaluated against
+# a RUNNING job's live telemetry instead of its corpse.
+# ---------------------------------------------------------------------------
+
+#: Finding codes that only make sense post-mortem: mid-run, every
+#: in-flight task is "granted but not completed" by construction and
+#: every open flow chain is unterminated — those are a live job's normal
+#: state, not a diagnosis.
+_POST_MORTEM_CODES = frozenset({
+    "incomplete-task", "incomplete-chain", "no-telemetry", "run-error",
+})
+
+#: Renewal-envelope series that sum fleet-wide into the wait-split fields
+#: _bottleneck_attribution understands (worker series are prefixed;
+#: strip to the JobStats field name).
+_WAIT_FIELDS = ("ingest_wait_s", "device_wait_s", "host_map_s",
+                "host_glue_s", "scan_wait_s", "all_to_all_s")
+
+
+def diagnose_live(stats_rpc: dict, lease_timeout_s: "float | None" = None,
+                  straggler_factor: float = 2.0,
+                  fleet: "dict | None" = None) -> dict:
+    """One streaming-doctor evaluation over a coordinator ``stats`` RPC
+    response (which IS a job-report dict plus ``progress``) and the
+    fleet's latest renewal-envelope samples. Reuses :func:`diagnose` —
+    the catalog is shared, not forked — then drops the post-mortem-only
+    codes and adds the live host-glue/stall bottleneck attribution when
+    the fleet samples carry wait-split series. Pure function: the
+    coordinator's tick and ``doctor --live`` both call it."""
+    manifest: dict = {"kind": "live"}
+    if lease_timeout_s:
+        manifest["config"] = {"lease_timeout_s": lease_timeout_s}
+    agg: dict = {}
+    for s in (fleet or {}).values():
+        for k, v in (s.get("v") or {}).items():
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            for field in _WAIT_FIELDS:
+                if str(k).endswith(field):
+                    agg[field] = agg.get(field, 0.0) + v
+    if any(agg.values()):
+        manifest["stats"] = agg
+    diag = diagnose(manifest, job_report=stats_rpc,
+                    straggler_factor=straggler_factor)
+    diag["kind"] = "live"
+    findings = [
+        f for f in diag["findings"] if f["code"] not in _POST_MORTEM_CODES
+    ]
+    bn = diag.get("bottleneck")
+    if bn and bn.get("name") not in (None, "balanced"):
+        top = (bn.get("attribution") or [{}])[0]
+        findings.append({
+            "severity": "info", "code": "live-bottleneck",
+            "key": "live-bottleneck",
+            "message": (
+                f"fleet-aggregated wait split currently names "
+                f"{bn['name']!r} ({top.get('seconds', 0):.3f}s, "
+                f"{(top.get('share') or 0):.0%} of attributed time)"
+            ),
+        })
+    diag["findings"] = findings
+    return diag
+
+
+def format_live(metrics_rpc: dict, stats_rpc: "dict | None" = None) -> str:
+    """Plain-text view of the coordinator ``metrics`` RPC — the streaming
+    findings (first-seen stamps, live/cleared state) and the fleet's
+    freshest samples. ``watch --doctor`` appends this under the progress
+    view; ``doctor --live`` prints it on its own."""
+    lines: list[str] = []
+    findings = metrics_rpc.get("findings") or []
+    if findings:
+        lines.append(f"  doctor[live]: {len(findings)} finding(s)")
+        for f in findings:
+            state = "live" if f.get("active", True) else "cleared"
+            lines.append(
+                f"    [{f['severity'].upper():<5}] {f['code']}"
+                f" (first seen {f.get('first_seen_s', 0):.1f}s, {state}): "
+                f"{f['message']}"
+            )
+    else:
+        lines.append("  doctor[live]: no findings yet")
+    fleet = metrics_rpc.get("fleet") or {}
+    for wid, s in sorted(fleet.items(), key=lambda kv: str(kv[0])):
+        v = s.get("v") or {}
+        parts = [
+            f"{k.split('.', 1)[-1]}={v[k]:g}" for k in sorted(v)
+            if isinstance(v[k], (int, float)) and not isinstance(v[k], bool)
+        ]
+        lines.append(
+            f"    w{wid} sample ({s.get('age_s', 0):.1f}s old): "
+            + (" ".join(parts[:8]) or "empty")
+        )
+    return "\n".join(lines)
+
+
+def run_live_cli(args) -> int:
+    """``doctor --live HOST:PORT``: poll the coordinator's stats+metrics
+    RPCs and stream findings as they appear, until the job completes (or
+    --once). Exit 0 on a completed/observed job, 1 when no coordinator
+    answers. Backend-free like every analysis tool."""
+    import asyncio
+
+    from mapreduce_rust_tpu.coordinator.server import (
+        CoordinatorClient,
+        RpcTimeout,
+    )
+
+    addr = args.live
+    host, _, port_s = addr.rpartition(":")
+    host = host or "127.0.0.1"
+    try:
+        port = int(port_s)
+    except ValueError:
+        print(f"doctor --live: bad address {addr!r} (want HOST:PORT)")
+        return 2
+    interval = getattr(args, "interval", None) or 1.0
+    once = bool(getattr(args, "once", False))
+
+    async def go() -> int:
+        client = CoordinatorClient(host, port,
+                                   timeout_s=max(interval * 5, 3.0))
+        try:
+            await client.connect(retries=5, delay=0.2)
+        except (OSError, RpcTimeout) as e:
+            print(f"doctor --live: no coordinator at {host}:{port} ({e})")
+            return 1
+        seen: set = set()
+        try:
+            while True:
+                try:
+                    rep = await client.call("stats")
+                    live = await client.call("metrics")
+                except RpcTimeout as e:
+                    print(f"doctor --live: coordinator not answering ({e})")
+                    return 1
+                except (ConnectionError, RuntimeError) as e:
+                    # Gone = job finished; RuntimeError = pre-metrics
+                    # coordinator (unknown method) — say which.
+                    if isinstance(e, RuntimeError) and "unknown method" in str(e):
+                        print("doctor --live: coordinator predates the "
+                              "metrics RPC — upgrade it or use post-run "
+                              "`doctor <manifest>`")
+                        return 2
+                    print("doctor --live: coordinator gone — job finished")
+                    return 0
+                if getattr(args, "format", "text") == "json":
+                    print(json.dumps({"stats": rep, "metrics": live},
+                                     sort_keys=True), flush=True)
+                else:
+                    for f in live.get("findings") or []:
+                        key = f.get("key") or f.get("code")
+                        if key not in seen:
+                            seen.add(key)
+                            print(
+                                f"[{f.get('first_seen_s', 0):>7.1f}s] "
+                                f"[{f['severity'].upper():<5}] "
+                                f"{f['code']}: {f['message']}", flush=True,
+                            )
+                done = (rep.get("progress") or {}).get("done")
+                if once or done:
+                    if getattr(args, "format", "text") == "text":
+                        print(format_live(live, rep))
+                        if done:
+                            print("doctor --live: job complete")
+                    return 0
+                await asyncio.sleep(interval)
+        finally:
+            await client.close()
+
+    return asyncio.run(go())
+
+
+# ---------------------------------------------------------------------------
 # Trend: N-round drift detection over .bench/history.jsonl (ISSUE 6)
 # ---------------------------------------------------------------------------
 
@@ -456,6 +639,10 @@ def diagnose(manifest: dict, job_report: "dict | None" = None,
 TREND_SERIES: dict[str, str] = {
     "value": "down",
     "zipf_gbs": "down",
+    # Live-metrics sampler tax (ISSUE 8): bench measures a metrics-on vs
+    # metrics-off pair each run; a creeping overhead fraction is exactly
+    # the slow-boil regression class trend exists for.
+    "metrics_overhead_frac": "up",
 }
 
 
@@ -681,6 +868,12 @@ def run_cli(args) -> int:
     analyzer (run_trend_cli) instead of the manifest diagnosis."""
     from mapreduce_rust_tpu.runtime.telemetry import load_manifest
 
+    if getattr(args, "live", None):
+        return run_live_cli(args)
+    if args.manifest is None:
+        print("doctor: need a manifest path (or --live HOST:PORT, or "
+              "'trend')")
+        return 2
     if args.manifest == "trend":
         return run_trend_cli(args)
     try:

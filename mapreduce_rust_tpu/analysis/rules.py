@@ -781,6 +781,146 @@ class UnboundedRetryRule(Rule):
             )
 
 
+class MetricInHotLoopRule(Rule):
+    """No metric mutations or wall-clock sampling inside the known
+    per-record hot loops.
+
+    The observability doctrine (runtime/metrics.py) allows per-window and
+    per-round telemetry but forbids per-record work — the reference's one
+    log line *per emitted KV pair* is the founding counter-example, and
+    ISSUE 8's live registry makes the mistake easy to re-introduce: a
+    registry ``inc`` is a lock acquire + dict update, ``record_hist`` is
+    a bisect, and ``time.time()`` is a syscall-class read; any of them
+    inside the scan fold or the a2a pack loop multiplies by the record
+    rate. The sampler exists precisely so these loops never need their
+    own instruments — they tick ``metrics_tick()`` once per window and
+    the registry pulls aggregates.
+
+    Precision: fires only inside ``for``/``while`` loops of the named
+    hot-loop scopes (the scan-fold and pack functions:
+    ``fold_scan_into_dictionary``, ``_pack_update``, ``_fold``,
+    ``add_scanned_raw``, ``_insert_hashed``). Three shapes match: (a)
+    wall-clock sampling (``time.time``/``perf_counter``/``monotonic``);
+    (b) mutations of a registry instrument — a call chained off
+    ``counter()``/``gauge()``/``histogram()``, a name assigned from one
+    in the same scope, or a mutator on a receiver whose qualname mentions
+    ``metric``/``registry``; (c) ``record_hist``/``metrics_tick``/
+    ``maybe_sample``/``ship_sample`` calls. The same calls OUTSIDE the
+    loops (per-window accounting after the fold) never match.
+    """
+
+    name = "metric-in-hot-loop"
+    summary = "no metric mutations / time sampling in per-record hot loops"
+
+    HOT_SCOPES = (
+        "fold_scan_into_dictionary",  # scan fold: native scan → dictionary
+        "_pack_update",               # a2a/merge pack: rows → padded update
+        "_fold",                      # HostAccumulator spill fold
+        "add_scanned_raw",            # dictionary per-token insert pass
+        "_insert_hashed",             # dictionary hashed-word insert loop
+    )
+    _CLOCKS = ("time", "perf_counter", "monotonic")
+    _MUTATORS = ("inc", "observe", "set", "set_total", "set_hist")
+    _FACTORIES = ("counter", "gauge", "histogram")
+    _TICKS = ("record_hist", "metrics_tick", "maybe_sample", "ship_sample")
+
+    def run(self, tree, src, path):
+        for scope in ast.walk(tree):
+            if not isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if scope.name not in self.HOT_SCOPES:
+                continue
+            yield from self._scan_scope(scope, path)
+
+    def _own_nodes(self, scope):
+        stack = list(scope.body)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # nested scope: not this hot loop's body
+            yield n
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _instrument_names(self, scope) -> set[str]:
+        """Names assigned from a registry factory call in this scope —
+        ``h = registry.histogram("x")`` makes ``h.observe`` a mutation."""
+        out: set[str] = set()
+        for n in self._own_nodes(scope):
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call) \
+                    and _last_segment(qualname(n.value.func)) in self._FACTORIES:
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+        return out
+
+    def _is_metric_mutation(self, call: ast.Call, instruments: set) -> bool:
+        if not isinstance(call.func, ast.Attribute):
+            return False
+        if call.func.attr not in self._MUTATORS:
+            return False
+        recv = call.func.value
+        # Chained off a factory: registry.counter("x").inc(...)
+        if isinstance(recv, ast.Call) and \
+                _last_segment(qualname(recv.func)) in self._FACTORIES:
+            return True
+        # A name bound from a factory in this scope.
+        if isinstance(recv, ast.Name) and recv.id in instruments:
+            return True
+        # Receiver path names the registry (self.metrics.…, registry.…) —
+        # conservative textual hint, scoped to the mutator verbs above.
+        q = qualname(recv).lower()
+        return "metric" in q or "registry" in q
+
+    def _is_clock(self, call: ast.Call) -> bool:
+        q = qualname(call.func)
+        if q == "time.time" or q.endswith(".time.time"):
+            return True
+        # perf_counter/monotonic are unambiguous in any spelling (bare
+        # from-import or module-qualified); a bare `time()` is not — it
+        # could be anything, so only the module-qualified form fires.
+        return _last_segment(q) in ("perf_counter", "monotonic")
+
+    def _scan_scope(self, scope, path):
+        instruments = self._instrument_names(scope)
+        seen: set[int] = set()
+        for loop in self._own_nodes(scope):
+            if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            for n in ast.walk(loop):
+                if not isinstance(n, ast.Call) or id(n) in seen:
+                    continue
+                seen.add(id(n))
+                last = _last_segment(qualname(n.func))
+                if self._is_clock(n):
+                    yield self.finding(
+                        path, n,
+                        f"wall-clock sampling ({qualname(n.func)}) inside "
+                        f"the {scope.name!r} hot loop runs per record — "
+                        "time once per window outside the loop, or let the "
+                        "registry sampler (metrics_tick at the window "
+                        "sites) carry the series",
+                    )
+                elif last in self._TICKS:
+                    yield self.finding(
+                        path, n,
+                        f"{last!r} inside the {scope.name!r} hot loop runs "
+                        "per record (a histogram add is a bisect, a "
+                        "sampler tick is a clock read + compare) — move it "
+                        "after the loop; the per-window sites already tick "
+                        "the sampler",
+                    )
+                elif self._is_metric_mutation(n, instruments):
+                    yield self.finding(
+                        path, n,
+                        f"registry instrument mutation inside the "
+                        f"{scope.name!r} hot loop — a lock acquire + dict "
+                        "update per record is the reference's per-KV log "
+                        "line all over again; accumulate locally and "
+                        "record once after the loop (the sampler pulls "
+                        "aggregates)",
+                    )
+
+
 # ---------------------------------------------------------------------------
 # Interprocedural program rules (the ISSUE 7 dataflow layer)
 # ---------------------------------------------------------------------------
@@ -1050,6 +1190,7 @@ ALL_RULES: list[Rule] = [
     JitInLoopRule(),
     PsumReplicatedFlagRule(),
     UnboundedRetryRule(),
+    MetricInHotLoopRule(),
 ]
 
 #: Interprocedural rules: run once per lint over the whole file set, on

@@ -174,6 +174,29 @@ class Config:
                                     # the partial. MR_FLIGHT_RECORD_S
                                     # overrides (test hook).
 
+    # ---- Live metrics plane (ISSUE 8) ----
+    metrics_enabled: bool = True    # live metrics registry + time-series
+                                    # ring (runtime/metrics.py): sampled
+                                    # from the existing consumer/poll/
+                                    # renewal loops — never per record —
+                                    # into manifests as stats.timeseries,
+                                    # shipped coordinator-ward in the
+                                    # renewal envelope. Cheap enough to
+                                    # default on; --no-metrics (bench's
+                                    # overhead pair) turns it off.
+    metrics_sample_period_s: float = 1.0  # wall-clock bucket width of the
+                                    # ring's points: one point per bucket
+                                    # however many loops tick the sampler
+    metrics_ring_points: int = 512  # ring capacity (oldest points evicted,
+                                    # eviction counted — a day-long run
+                                    # keeps its newest ~8.5 min at 1 Hz;
+                                    # raise the period for long jobs)
+    metrics_port: int = 0           # coordinator-only: serve Prometheus
+                                    # text exposition (GET /metrics) on
+                                    # this port from a dedicated thread;
+                                    # 0 = off. Standard scrapers work
+                                    # against a long-lived coordinator.
+
     # ---- Active fault tolerance (speculation / chaos / degradation) ----
     speculate: bool = False         # coordinator speculative re-execution:
                                     # near phase end, re-issue the slowest
@@ -253,6 +276,12 @@ class Config:
         if self.rpc_backoff_base_s <= 0 or self.rpc_backoff_cap_s <= 0 \
                 or self.rpc_backoff_budget_s <= 0:
             raise ValueError("rpc_backoff_* must be positive")
+        if self.metrics_sample_period_s <= 0:
+            raise ValueError("metrics_sample_period_s must be positive")
+        if self.metrics_ring_points < 8:
+            raise ValueError("metrics_ring_points must be >= 8")
+        if self.metrics_port < 0:
+            raise ValueError("metrics_port must be >= 0 (0 = off)")
         if self.poll_retry_cap_s is not None and self.poll_retry_cap_s <= 0:
             raise ValueError("poll_retry_cap_s must be positive (or None)")
         if self.chaos:

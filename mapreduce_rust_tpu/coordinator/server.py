@@ -42,6 +42,10 @@ import uuid
 import time
 
 from mapreduce_rust_tpu.config import Config
+from mapreduce_rust_tpu.runtime.metrics import (
+    MetricsHTTPServer,
+    MetricsRegistry,
+)
 from mapreduce_rust_tpu.runtime.telemetry import JobReport, write_job_report
 from mapreduce_rust_tpu.runtime.backoff import Backoff, BackoffExhausted
 from mapreduce_rust_tpu.runtime.trace import (
@@ -212,6 +216,20 @@ class Coordinator:
         # attempt pair, kept until first finish (winner decided) or lease
         # expiry (both attempts dead).
         self._spec: dict[tuple[str, int], dict] = {}
+        # Live telemetry plane (ISSUE 8). INSTANCE registry, deliberately
+        # not the process-global slot: in-process clusters co-host workers
+        # whose runs own the global one, and the fleet view must survive
+        # them. Workers ship their latest sample in the renewal envelope;
+        # the serve tick republishes everything as gauges/counters/hists,
+        # samples the ring, renders the scrape text, and evaluates the
+        # live doctor.
+        self.registry = (
+            MetricsRegistry(cfg.metrics_sample_period_s,
+                            cfg.metrics_ring_points)
+            if cfg.metrics_enabled else None
+        )
+        self.fleet: dict[int, dict] = {}  # wid → latest envelope sample
+        self._live_findings: dict[str, dict] = {}  # key → finding+first_seen
         self._journal_path = os.path.join(cfg.work_dir, "coordinator.journal")
         if resume:
             self._replay_journal()
@@ -414,15 +432,89 @@ class Coordinator:
             return NOT_READY  # phase gate (coordinator.rs:183-185)
         return self._grant(self.reduce, "reduce", wid)
 
-    def renew_map_lease(self, tid: int, wid: int = -1) -> bool:
+    # ``sample`` on the renewal RPCs (ISSUE 8): the worker's latest live
+    # metrics point rides the heartbeat it already sends — trailing with
+    # default, like ``wid``, so pre-metrics clients and in-process test
+    # callers stay wire-valid. This is the fleet-wide live view the
+    # multi-tenant service will need for admission control.
+
+    def renew_map_lease(self, tid: int, wid: int = -1, sample=None) -> bool:
         ok = self.map.renew(tid)
         self.report.record_renewal("map", tid, ok, wid=wid)
+        self._ingest_sample(wid, sample)
         return ok
 
-    def renew_reduce_lease(self, tid: int, wid: int = -1) -> bool:
+    def renew_reduce_lease(self, tid: int, wid: int = -1, sample=None) -> bool:
         ok = self.reduce.renew(tid)
         self.report.record_renewal("reduce", tid, ok, wid=wid)
+        self._ingest_sample(wid, sample)
         return ok
+
+    def _ingest_sample(self, wid, sample) -> None:
+        """Fold one renewal-envelope sample into the fleet view and the
+        registry (as per-worker labeled gauges, so the scrape endpoint and
+        the ring carry the same series). Defensive by construction: an
+        envelope is remote input — non-numeric values are dropped and the
+        per-sample series count is capped so a confused worker cannot
+        balloon the registry."""
+        if (
+            sample is None or self.registry is None
+            or not isinstance(sample, dict)
+            or not isinstance(wid, int)
+            # Only wids this coordinator actually issued: the wid is an
+            # unauthenticated RPC param, and an arbitrary int per call
+            # would grow the fleet map + per-wid gauge label-sets without
+            # bound on a long-lived coordinator.
+            or not (0 <= wid < self.worker_count)
+        ):
+            return
+        values = sample.get("v")
+        if not isinstance(values, dict):
+            return
+        kept: dict = {}
+        for k, v in list(values.items())[:64]:
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            kept[str(k)] = v
+            try:
+                self.registry.gauge(str(k)).set(v, wid=str(wid))
+            except ValueError:
+                # Remote-named series colliding with a coordinator-owned
+                # counter/histogram name: keep it in the fleet view, skip
+                # the registry — a confused worker must never crash the
+                # renewal handler (the lease was already renewed).
+                continue
+        self.fleet[wid] = {
+            "t": sample.get("t"),
+            "age_s": 0.0,  # refreshed at serve time in metrics()
+            "recv_uptime_s": round(self.report.uptime_s(), 3),
+            "v": kept,
+        }
+
+    def metrics(self) -> dict:
+        """The 10th RPC: the live telemetry view — the coordinator's
+        latest ring point + series catalog, the per-worker fleet samples,
+        and the streaming doctor's findings with first-seen timestamps.
+        Plain JSON scalars/dicts, same transport as everything else."""
+        now = self.report.uptime_s()
+        fleet = {}
+        for wid, s in self.fleet.items():
+            fleet[str(wid)] = {
+                **s, "age_s": round(now - s["recv_uptime_s"], 3),
+            }
+        out: dict = {
+            "enabled": self.registry is not None,
+            "uptime_s": round(now, 3),
+            "findings": sorted(
+                self._live_findings.values(),
+                key=lambda f: f["first_seen_s"],
+            ),
+            "fleet": fleet,
+        }
+        if self.registry is not None:
+            out["latest"] = self.registry.latest()
+            out["series"] = self.registry.series_catalog()
+        return out
 
     def _finish(self, phase: "_Phase", name: str, tid: int, attempt: int,
                 wid: int = -1) -> bool:
@@ -586,8 +678,82 @@ class Coordinator:
         "get_worker_id", "get_map_task", "get_reduce_task",
         "renew_map_lease", "renew_reduce_lease",
         "report_map_task_finish", "report_reduce_task_finish",
-        "deregister_worker", "stats",
+        "deregister_worker", "stats", "metrics",
     })
+
+    # ---- live telemetry ticks (serve loop — never the RPC hot path) ----
+
+    def _metrics_tick(self, http_srv=None, force: bool = False) -> None:
+        """Republish the control plane into the registry, sample the ring,
+        and hand the scrape endpoint its next body. Runs ON the event loop
+        (serialized with every handler), so reading the report is safe;
+        the HTTP thread only ever serves pre-rendered bytes. Gated on the
+        ring's bucket cadence: the serve loop passes several times per
+        second, and the republish (histogram copies) + text render are
+        only worth doing when a point will actually land."""
+        g = self.registry
+        if g is None or not (force or g.due()):
+            return
+        prog = self.progress()
+        g.gauge("coordinator.uptime_s").set(prog["uptime_s"])
+        workers = prog["workers"]
+        g.gauge("coordinator.workers_registered").set(workers["registered"])
+        g.gauge("coordinator.workers_active").set(workers["active"])
+        g.gauge("coordinator.job_done").set(int(prog["done"]))
+        for name, ph in prog["phases"].items():
+            for field in ("issued", "done", "in_flight", "pending",
+                          "expired", "late_reports", "stale"):
+                g.gauge(f"phase.{field}").set(ph[field], phase=name)
+        for method, h in self.report._rpc.items():
+            g.counter("rpc.calls").set_total(h.count, method=method)
+            g.histogram("rpc.latency_s").set_hist(h, method=method)
+        for phase, h in self.report._phase_hist.items():
+            g.histogram("task.duration_s").set_hist(h, phase=phase)
+        g.maybe_sample()
+        if http_srv is not None:
+            http_srv.publish(g.prometheus_text())
+
+    def _doctor_tick(self) -> None:
+        """Streaming doctor (ISSUE 8): evaluate the existing finding
+        catalog against the LIVE report + fleet samples. A finding's first
+        appearance is stamped (coordinator uptime) and dropped into the
+        trace as an instant, so the merged timeline shows WHEN the
+        diagnosis became true — not just that the corpse had it."""
+        from mapreduce_rust_tpu.analysis.doctor import diagnose_live
+
+        try:
+            diag = diagnose_live(
+                self.stats(),
+                lease_timeout_s=self.cfg.lease_timeout_s,
+                fleet=self.fleet,
+            )
+        except Exception as e:  # diagnosis must never wedge the scheduler
+            log.warning("live doctor tick failed: %r", e)
+            return
+        now = round(self.report.uptime_s(), 3)
+        current: set = set()
+        for f in diag.get("findings") or []:
+            key = f.get("key") or f["code"]
+            current.add(key)
+            known = self._live_findings.get(key)
+            if known is None:
+                self._live_findings[key] = {
+                    **f, "key": key,
+                    "first_seen_s": now, "last_seen_s": now, "active": True,
+                }
+                trace_instant("doctor.finding", code=f["code"], key=key,
+                              severity=f["severity"])
+                log.info("doctor[live] NEW [%s] %s: %s",
+                         f["severity"], f["code"], f["message"])
+            else:
+                known.update({
+                    "message": f["message"], "severity": f["severity"],
+                    "last_seen_s": now, "active": True,
+                })
+        for key, f in self._live_findings.items():
+            if key not in current:
+                f["active"] = False  # kept with first_seen — history, not
+                # a gauge: a straggler that recovered still happened
 
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
         try:
@@ -688,6 +854,22 @@ class Coordinator:
                 partial_path(per_process_path(self.cfg.trace_path, "coord")),
                 period_s=self.cfg.flight_record_period_s,
             )
+            if self.registry is not None:
+                tracer.metrics_registry = self.registry  # partials keep
+                # the fleet series a SIGKILL would otherwise take down
+        http_srv = None
+        if self.cfg.metrics_port and self.registry is not None:
+            try:
+                http_srv = MetricsHTTPServer(self.cfg.metrics_port,
+                                             host=self.cfg.host)
+                log.info("metrics: Prometheus endpoint on http://%s:%d/metrics",
+                         http_srv.host, http_srv.port)
+            except OSError as e:
+                # A taken port must not cost the job — the scheduler is
+                # the product, the scrape endpoint is telemetry.
+                log.warning("metrics endpoint failed to bind port %d: %s",
+                            self.cfg.metrics_port, e)
+        self.metrics_http = http_srv  # tests read the bound (ephemeral) port
         server = await asyncio.start_server(self._handle, self.cfg.host, self.cfg.port)
         log.info("coordinator on %s:%d (map_n=%d reduce_n=%d worker_n=%d)",
                  self.cfg.host, self.cfg.port, self.cfg.map_n, self.cfg.reduce_n, self.cfg.worker_n)
@@ -697,10 +879,15 @@ class Coordinator:
                 await asyncio.sleep(min(1.0, self.cfg.lease_check_period_s))
                 if time.monotonic() - last_check >= self.cfg.lease_check_period_s:
                     self.check_lease()
+                    # Streaming doctor at the detector's cadence: the
+                    # straggler/lease/skew catalog over the live report,
+                    # findings surfaced mid-run (ISSUE 8).
+                    self._doctor_tick()
                     last_check = time.monotonic()
+                # Registry republish + ring sample + scrape-text publish
+                # from the existing poll loop — never the RPC hot path.
+                self._metrics_tick(http_srv)
                 if tracer is not None:
-                    # Flight-recorder tick from the existing poll loop —
-                    # never the RPC hot path.
                     tracer.maybe_snapshot()
             # Job done: dump the control-plane report where a BENCH probe
             # (or a human) finds structured state instead of log tails.
@@ -726,6 +913,24 @@ class Coordinator:
                 "kind": "coordinator_manifest",
                 "job_report": self.report.to_dict(),
             }
+            if self.registry is not None:
+                # Republish the FINAL control-plane state (the cadence
+                # gate may have skipped the last serve passes), then a
+                # forced sample, then the ring rides the manifest as
+                # stats.timeseries — the acceptance artifact the scrape
+                # endpoint's series are checked against. Snapshotted ON
+                # the loop like the report (instance registry: the global
+                # slot may belong to a co-hosted worker).
+                self._metrics_tick(force=True)
+                self.registry.maybe_sample(force=True)
+                extra["stats"] = {
+                    "timeseries": self.registry.timeseries_dict(),
+                }
+            if self._live_findings:
+                extra["live_findings"] = sorted(
+                    self._live_findings.values(),
+                    key=lambda f: f["first_seen_s"],
+                )
 
             def _flush() -> None:
                 flush_run_artifacts(self.cfg, tracer, tag="coord",
@@ -736,6 +941,13 @@ class Coordinator:
             # as a wedged coordinator to the pollers
             # (mrlint: blocking-in-async).
             await asyncio.get_running_loop().run_in_executor(None, _flush)
+            if http_srv is not None:
+                # close() blocks on ThreadingHTTPServer.shutdown (up to
+                # its 0.5 s poll) + a thread join — off the loop, like
+                # _flush (mrlint: blocking-in-async).
+                await asyncio.get_running_loop().run_in_executor(
+                    None, http_srv.close
+                )
             server.close()
             await server.wait_closed()
 

@@ -73,7 +73,14 @@ from mapreduce_rust_tpu.runtime.dictionary import (
     new_run_token,
     remove_run_files,
 )
-from mapreduce_rust_tpu.runtime.metrics import JobStats, log
+from mapreduce_rust_tpu.runtime.metrics import (
+    JobStats,
+    jobstats_collector,
+    log,
+    metrics_tick,
+    start_metrics,
+    stop_metrics,
+)
 from mapreduce_rust_tpu.runtime.trace import (
     active_tracer,
     maybe_snapshot,
@@ -676,6 +683,7 @@ class _IngestStream:
             # fold the oldest (blocking) once the backlog exceeds the pool.
             self._fold_done(block=len(self.scans) > 2 * self.workers + 4)
             maybe_snapshot()  # flight-recorder tick: per chunk, off-hot-path
+            metrics_tick()    # live-metrics sampler, same piggyback contract
             yield chunk
 
     def close(self, abort: bool = False) -> None:
@@ -1004,6 +1012,7 @@ def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
         stats.host_glue_s += glue_dt
         stats.record_hist("host_map.glue_s", glue_dt)
         maybe_snapshot()  # flight-recorder tick: per window, consumer thread
+        metrics_tick()    # live-metrics sampler, same piggyback contract
         if len(pending) >= 2 * depth:
             drain(depth)
 
@@ -1767,6 +1776,18 @@ def run_job(
             partial_path(cfg.trace_path),
             period_s=cfg.flight_record_period_s,
         )
+    # Live metrics (ISSUE 8): the registry pulls JobStats aggregates into
+    # the time-series ring when the SAME loops that tick the flight
+    # recorder call metrics_tick() — no engine grows a second
+    # instrumentation site, nothing runs per record. Serialized into the
+    # manifest as stats.timeseries by build_manifest.
+    registry = None
+    if cfg.metrics_enabled:
+        registry = start_metrics(cfg.metrics_sample_period_s,
+                                 cfg.metrics_ring_points)
+        registry.add_collector(jobstats_collector(stats))
+        if tracer is not None:
+            tracer.metrics_registry = registry  # partials keep the series
     output_files: list[str] = []
     table: dict = {}
 
@@ -1909,6 +1930,11 @@ def run_job(
                 stats=stats, app_name=app.name, inputs=inputs,
                 output_files=output_files, extra=extra or None,
             )
+        if registry is not None:
+            # After the flush: build_manifest serialized the ring from the
+            # still-active registry. Compare-and-clear: an in-process
+            # co-hosted worker may have replaced the global slot.
+            stop_metrics(registry)
     return JobResult(stats=stats, table=table, output_files=output_files)
 
 

@@ -629,6 +629,25 @@ def build_manifest(cfg, stats=None, app_name: str | None = None,
         m["probes"] = probes
     if extra:
         m.update(extra)
+    # Live metrics ring (ISSUE 8): whatever registry is active in THIS
+    # process serializes into stats.timeseries — for the driver beside the
+    # full JobStats dict, for the coordinator/worker (no JobStats in their
+    # manifests) as the stats block's only member. A final forced sample
+    # first, so even a sub-period run carries at least one point.
+    try:
+        from mapreduce_rust_tpu.runtime.metrics import active_registry
+
+        reg = active_registry()
+        if reg is not None:
+            stats_block = m.setdefault("stats", {})
+            if "timeseries" not in stats_block:
+                # An explicit ring in ``extra`` wins: the coordinator owns
+                # an instance registry (in-process clusters share this
+                # process with workers, whose rings own the global slot).
+                reg.maybe_sample(force=True)
+                stats_block["timeseries"] = reg.timeseries_dict()
+    except Exception:
+        pass  # telemetry stays best-effort
     return m
 
 
@@ -800,10 +819,12 @@ def diff_manifests(a: dict, b: dict) -> list[str]:
         if key.startswith(skip) or key in skip:
             continue
         # Raw histogram internals (sparse bucket maps, embedded hist
-        # copies) and the ordered event log (mrcheck's replay substrate —
-        # timestamps differ every run by construction): the aggregate
-        # fields beside them carry the comparable signal.
-        if any(seg in ("buckets", "hist", "events") for seg in key.split(".")):
+        # copies), the ordered event log (mrcheck's replay substrate) and
+        # the live time-series ring (wall-clock-stamped points — they
+        # differ every run by construction): the aggregate fields beside
+        # them carry the comparable signal.
+        if any(seg in ("buckets", "hist", "events", "timeseries")
+               for seg in key.split(".")):
             continue
         va, vb = fa.get(key, "<absent>"), fb.get(key, "<absent>")
         if va == vb:

@@ -107,6 +107,9 @@ class Tracer:
         self._events: list[tuple] = []  # append is GIL-atomic
         self.tag = tag                  # process role for track naming
         self.clock_sync = None          # ClockSync (or dict) to the coordinator
+        self.metrics_registry = None    # live MetricsRegistry (ISSUE 8):
+        # when set, flight-recorder partials embed the time-series ring, so
+        # a SIGKILLed run keeps its sampled series alongside its events
         # Flight recorder state (see enable_flight_recorder).
         self._snap_path: "str | None" = None
         self._snap_period = 5.0
@@ -290,6 +293,15 @@ class Tracer:
                 "displayTimeUnit": "ms",
                 "metadata": self.metadata(partial=True),
             }
+            reg = self.metrics_registry
+            if reg is not None:
+                try:
+                    # The series ride the partial: a SIGKILLed run's ring
+                    # would otherwise die with the process before any
+                    # manifest flush could serialize it.
+                    body["metrics"] = reg.timeseries_dict()
+                except Exception:
+                    pass  # the recorder must never fail the run
             d = os.path.dirname(os.path.abspath(path))
             os.makedirs(d, exist_ok=True)
             tmp = f"{path}.{self._pid}.tmp"
@@ -668,7 +680,8 @@ def _repair_flow_causality(merged: "list[dict]") -> None:
                     e["ts"] = f["ts"]
 
 
-def merge_traces(out_path: str, paths: "list[str]") -> dict:
+def merge_traces(out_path: str, paths: "list[str]",
+                 out_format: str = "json") -> dict:
     """Stitch per-process trace files (partials included) onto ONE
     timeline and write a Perfetto-loadable file to ``out_path``.
 
@@ -770,26 +783,36 @@ def merge_traces(out_path: str, paths: "list[str]") -> dict:
     merged.sort(key=lambda ev: (0 if ev.get("ph") == "M" else 1, ev["ts"]))
 
     validate_events(merged)
-    d = os.path.dirname(os.path.abspath(out_path))
-    os.makedirs(d, exist_ok=True)
-    tmp = f"{out_path}.{os.getpid()}.tmp"
-    with open(tmp, "w") as f:
-        json.dump(
-            {
-                "traceEvents": merged,
-                "displayTimeUnit": "ms",
-                "metadata": {
-                    "merged_from": [t["path"] for t in traces],
-                    "reference": {
-                        "path": ref["path"],
-                        "tag": ref["md"].get("tag"),
+    if out_format == "perfetto":
+        # Binary track_event protobuf (ISSUE 8 satellite — the PR 4
+        # leftover): same merged-and-validated stream, serialized for the
+        # timelines the JSON loader chokes on. JSON stays the default.
+        from mapreduce_rust_tpu.runtime.perfetto import write_pftrace
+
+        write_pftrace(merged, out_path)
+    elif out_format == "json":
+        d = os.path.dirname(os.path.abspath(out_path))
+        os.makedirs(d, exist_ok=True)
+        tmp = f"{out_path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {
+                    "traceEvents": merged,
+                    "displayTimeUnit": "ms",
+                    "metadata": {
+                        "merged_from": [t["path"] for t in traces],
+                        "reference": {
+                            "path": ref["path"],
+                            "tag": ref["md"].get("tag"),
+                        },
                     },
                 },
-            },
-            f,
-            separators=(",", ":"),
-        )
-    os.replace(tmp, out_path)
+                f,
+                separators=(",", ":"),
+            )
+        os.replace(tmp, out_path)
+    else:
+        raise ValueError(f"unknown trace merge format {out_format!r}")
     span_s = (max(real_ts) - t_min) / 1e6 if real_ts else 0.0
     return {
         "out": out_path,

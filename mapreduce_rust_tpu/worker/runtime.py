@@ -57,6 +57,10 @@ from mapreduce_rust_tpu.core.hashing import hash_words
 from mapreduce_rust_tpu.runtime.backoff import Backoff, BackoffExhausted
 from mapreduce_rust_tpu.runtime.chunker import chunk_stream, list_inputs
 from mapreduce_rust_tpu.runtime.dictionary import Dictionary, extract_words
+from mapreduce_rust_tpu.runtime.metrics import (
+    start_metrics,
+    stop_metrics,
+)
 from mapreduce_rust_tpu.runtime.telemetry import JobReport
 from mapreduce_rust_tpu.runtime.trace import (
     maybe_snapshot,
@@ -134,6 +138,40 @@ class Worker:
         from mapreduce_rust_tpu.analysis.sanitize import new_job_stats
 
         self.stats = new_job_stats(cfg)
+        self.registry = None  # THIS worker's live registry (ISSUE 8);
+        # the process-global slot is unreliable under in-process
+        # co-hosted workers, so every worker-side tick/ship uses this.
+
+    def _metrics_tick(self) -> None:
+        """Sampler tick on this worker's own registry (the global
+        metrics_tick() would sample whichever co-hosted worker installed
+        the slot last)."""
+        reg = self.registry
+        if reg is not None:
+            reg.maybe_sample()
+
+    def _metrics_collect(self) -> dict:
+        """Pull source for the live registry (ISSUE 8): the worker-side
+        series that ship to the coordinator in the renewal envelope and
+        land in this worker's manifest ring. Plain attribute/dict reads —
+        benign against the executor threads that write them."""
+        h = self.stats.hists.get("worker.task_s")
+        return {
+            "worker.bytes_in": self.stats.bytes_in,
+            "worker.tasks_done": h.count if h is not None else 0,
+            "worker.task_s_sum": round(h.total, 6) if h is not None else 0.0,
+            "worker.device_mem_high_bytes": self.stats.device_mem_high_bytes,
+            "worker.revoked_tasks": len(self.revoked_tasks),
+            # Wait split (folded per task, executor thread): the live
+            # doctor aggregates these fleet-wide into its bottleneck
+            # attribution (diagnose_live._WAIT_FIELDS).
+            "worker.host_map_s": round(self.stats.host_map_s, 6),
+            "worker.host_glue_s": round(self.stats.host_glue_s, 6),
+            "worker.ingest_wait_s": round(self.stats.ingest_wait_s, 6),
+            "worker.device_wait_s": round(self.stats.device_wait_s, 6),
+            "worker.scan_wait_s": round(self.stats.scan_wait_s, 6),
+            "worker.all_to_all_s": round(self.stats.all_to_all_s, 6),
+        }
 
     @property
     def _wid(self) -> int:
@@ -266,8 +304,17 @@ class Worker:
         from mapreduce_rust_tpu.runtime.driver import HostAccumulator, _stream_single
 
         acc = HostAccumulator(self.app.combine_op)
-        _stream_single(self.cfg, self.app, [path], new_job_stats(self.cfg), acc,
+        task_stats = new_job_stats(self.cfg)
+        _stream_single(self.cfg, self.app, [path], task_stats, acc,
                        dictionary, doc_id_offset=doc_id)
+        # Fold the task-local wait split into the worker's stats (executor
+        # thread: a registered writer) so the renewal envelope ships a real
+        # per-worker wait breakdown — the live doctor's fleet-wide
+        # bottleneck attribution reads exactly these fields (ISSUE 8).
+        for field in ("host_map_s", "host_glue_s", "ingest_wait_s",
+                      "device_wait_s", "scan_wait_s", "all_to_all_s"):
+            setattr(self.stats, field,
+                    getattr(self.stats, field) + getattr(task_stats, field))
         return acc.table, dictionary
 
     def _chaos_task_entry(self, phase: str, tid: int, att: int) -> None:
@@ -316,7 +363,14 @@ class Worker:
             self.stats.bytes_in += os.path.getsize(path)
         except OSError:
             pass
+        t_map = time.perf_counter()
         table, dictionary = self._map_table(tid, path)
+        if self.engine != "device":
+            # Per-task (never per-record) scan accounting: the device path
+            # folds its own exact wait split in _map_table_device; the
+            # host/python paths book the whole table build as scan time so
+            # the renewal envelope still ships a usable host_map_s series.
+            self.stats.host_map_s += time.perf_counter() - t_map
         self.work.mkdir(parents=True, exist_ok=True)
         op = self.app.combine_op
         reduce_n = self.cfg.reduce_n
@@ -449,7 +503,21 @@ class Worker:
                 await asyncio.sleep(self.cfg.lease_renew_period_s)
                 if stop.is_set():
                     return
-                ok = await self._call(client, method, tid, self._wid)
+                # Latest metrics sample rides the renewal envelope as a
+                # TRAILING arg (ISSUE 8) — same wire-compat trick as wid:
+                # an in-process/pre-metrics caller omits it and the
+                # coordinator's default applies. Computed before the call
+                # (cheap flat dict), shipped only when metrics are on.
+                # THIS worker's registry, never the process-global slot:
+                # in-process co-hosted workers replace the global, and a
+                # sample shipped under the wrong wid would show every
+                # worker with the last-started worker's stats.
+                reg = self.registry
+                if reg is not None:
+                    ok = await self._call(client, method, tid, self._wid,
+                                          reg.ship_sample())
+                else:
+                    ok = await self._call(client, method, tid, self._wid)
                 if stop.is_set():
                     return  # a swallowed cancel still exits here
                 self.report.record_renewal(phase, tid, bool(ok), wid=self._wid)
@@ -458,6 +526,7 @@ class Worker:
                 # take 100s of ms, and the heartbeat must never queue
                 # behind telemetry (a delayed renewal is a lease expiry).
                 maybe_snapshot()
+                self._metrics_tick()
                 if not ok:
                     if revoked is not None and client.last_revoked:
                         revoked.set()
@@ -543,6 +612,7 @@ class Worker:
                 return False
             if tid in (NOT_READY, WAIT):
                 maybe_snapshot()
+                self._metrics_tick()
                 self._sample_memory()
                 await asyncio.sleep(poll.next_delay())
                 continue
@@ -624,6 +694,7 @@ class Worker:
             self.report.record_finish(phase, tid, wid=self._wid,
                                       attempt=self._attempts.get((phase, tid)))
             maybe_snapshot()
+            self._metrics_tick()
 
     async def run(self) -> None:
         # The loop thread may not be the thread that CONSTRUCTED this
@@ -641,6 +712,22 @@ class Worker:
                 partial_path(per_process_path(self.cfg.trace_path, tag)),
                 period_s=self.cfg.flight_record_period_s,
             )
+        # Live metrics (ISSUE 8): sampled from the renewal/poll loops into
+        # this worker's ring (→ manifest stats.timeseries) and shipped to
+        # the coordinator in the renewal envelope for the fleet-wide view.
+        registry = None
+        if self.cfg.metrics_enabled:
+            # start_metrics installs the global slot too (the OS-process
+            # case: build_manifest and engine-side ticks read it), but
+            # every worker-side use goes through self.registry — the
+            # global is last-writer-wins under in-process co-hosting.
+            registry = self.registry = start_metrics(
+                self.cfg.metrics_sample_period_s,
+                self.cfg.metrics_ring_points,
+            )
+            registry.add_collector(self._metrics_collect)
+            if tracer is not None:
+                tracer.metrics_registry = registry
         client = CoordinatorClient(
             self.cfg.host, self.cfg.port,
             timeout_s=self.cfg.rpc_timeout_s, sync=self.sync,
@@ -723,3 +810,9 @@ class Worker:
             # shells out to git and writes trace/manifest files — nothing
             # else on this loop should stall behind teardown telemetry.
             await asyncio.get_running_loop().run_in_executor(None, _flush)
+            if registry is not None:
+                # After the flush: build_manifest serialized the ring from
+                # the still-active registry. Compare-and-clear — a
+                # co-hosted worker may own the global slot by now.
+                stop_metrics(registry)
+                self.registry = None

@@ -296,6 +296,24 @@ def test_trend_insufficient_data_is_not_a_drift(tmp_path):
     assert t["drifts"] == []
 
 
+def test_trend_watches_metrics_overhead_frac():
+    # ISSUE 8 satellite: the sampler-tax series from bench.py's on/off
+    # pair is a watched metric whose BAD direction is UP — a creeping
+    # overhead fraction drifts, a noisy-but-flat one stays quiet.
+    from mapreduce_rust_tpu.analysis.doctor import analyze_trend
+
+    creeping = [{"value": 1.0, "metrics_overhead_frac": round(0.002 * (1.5 ** i), 5)}
+                for i in range(9)]
+    t = analyze_trend(creeping)
+    assert t["series"]["metrics_overhead_frac"]["status"] == "drifting"
+    assert any(d["metric"] == "metrics_overhead_frac" for d in t["drifts"])
+
+    noisy_flat = [{"value": 1.0, "metrics_overhead_frac": v}
+                  for v in [0.01, -0.005, 0.008, 0.002, -0.01, 0.009, 0.001,
+                            0.004]]
+    assert analyze_trend(noisy_flat)["drifts"] == []
+
+
 def test_trend_cli_exit_codes(tmp_path, capsys):
     stable = _history(tmp_path, [1.0, 1.01, 0.99, 1.0, 1.0, 1.01])
     assert main(["doctor", "trend", stable]) == 0

@@ -561,6 +561,82 @@ def test_unbounded_retry_silent_on_bounded_and_conditioned_loops(tmp_path):
     """) == []
 
 
+# ---------------------------------------------------------------------------
+# metric-in-hot-loop (ISSUE 8)
+# ---------------------------------------------------------------------------
+
+def test_metric_in_hot_loop_fires_on_registry_inc_per_record(tmp_path):
+    findings, _ = run_lint(tmp_path, """
+        def fold_scan_into_dictionary(dictionary, rows, registry):
+            for word, count in rows:
+                dictionary.add(word, count)
+                registry.counter("records").inc()   # per-record lock+dict
+    """)
+    assert [f.rule for f in findings] == ["metric-in-hot-loop"]
+    assert "per record" in findings[0].message
+
+
+def test_metric_in_hot_loop_fires_on_clock_and_bound_instrument(tmp_path):
+    findings, _ = run_lint(tmp_path, """
+        import time
+
+        def _pack_update(rows, registry):
+            h = registry.histogram("pack_s")
+            out = []
+            for r in rows:
+                t0 = time.perf_counter()    # wall-clock read per record
+                out.append(pack(r))
+                h.observe(time.perf_counter() - t0)  # bisect per record
+            return out
+    """)
+    fired = sorted(f.rule for f in findings)
+    assert fired == ["metric-in-hot-loop"] * len(fired) and len(findings) >= 2
+
+
+def test_metric_in_hot_loop_fires_on_hist_and_tick_in_loop(tmp_path):
+    assert rules_fired(tmp_path, """
+        def _fold(self, spill):
+            for key, rows in spill:
+                self.merge(key, rows)
+                self.stats.record_hist("fold_s", 0.0)  # per-record bisect
+                metrics_tick()                          # per-record sampler
+    """) == ["metric-in-hot-loop"]
+
+
+def test_metric_in_hot_loop_silent_outside_loop_and_scope(tmp_path):
+    # The shipped pattern: accumulate in the loop, record ONCE after —
+    # and the same calls in a non-hot function never match.
+    assert rules_fired(tmp_path, """
+        import time
+
+        def fold_scan_into_dictionary(dictionary, rows, stats, registry):
+            t0 = time.perf_counter()
+            n = 0
+            for word, count in rows:
+                dictionary.add(word, count)
+                n += 1
+            stats.record_hist("fold_s", time.perf_counter() - t0)
+            registry.counter("records").inc(n)
+            metrics_tick()
+
+        def consume_window(window, registry):
+            for chunk in window:           # not a named hot scope
+                registry.counter("chunks").inc()
+                time.time()
+    """) == []
+
+
+def test_metric_in_hot_loop_silent_on_plain_set_calls(tmp_path):
+    # `set` is a mutator verb, but only on metric-ish receivers: plain
+    # dataclass/dict mutation in the fold must not fire.
+    assert rules_fired(tmp_path, """
+        def _insert_hashed(self, hashes, counts):
+            for h, c in zip(hashes, counts):
+                self.table.set(h, c)        # receiver is not a registry
+                self.flags.set()
+    """) == []
+
+
 BAD_SNIPPET = """
     def shard(dictionary):
         return list(dictionary.items())

@@ -1,0 +1,665 @@
+"""Live telemetry plane (ISSUE 8): the metrics registry + time-series
+ring, the Prometheus scrape endpoint, the renewal-envelope fleet view,
+and the streaming doctor.
+
+Tier-1 carries the registry/exposition units, the scrape-endpoint
+conformance test, and ONE deterministic live-doctor cluster: a chaos
+``slow_scan`` leg drives real OS processes while ``watch --doctor --once
+--json`` (polled in-test) observes the straggler finding BEFORE the job
+ends — the post-hoc-only gap this PR closes.
+"""
+
+import json
+import pathlib
+import re
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from mapreduce_rust_tpu.runtime.metrics import (
+    MetricsHTTPServer,
+    MetricsRegistry,
+    _prom_name,
+    active_registry,
+    jobstats_collector,
+    metrics_tick,
+    start_metrics,
+    stop_metrics,
+)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+# ---------------------------------------------------------------------------
+# Registry semantics
+# ---------------------------------------------------------------------------
+
+def test_registration_idempotent_by_name_and_kind_conflict_raises():
+    reg = MetricsRegistry()
+    c1 = reg.counter("rpc.calls", help="n")
+    assert reg.counter("rpc.calls") is c1
+    with pytest.raises(ValueError, match="already registered as counter"):
+        reg.gauge("rpc.calls")
+
+
+def test_counter_gauge_histogram_with_labels():
+    reg = MetricsRegistry()
+    reg.counter("req").inc(2, method="get")
+    reg.counter("req").inc(method="get")
+    reg.counter("req").inc(method="put")
+    reg.gauge("depth").set(7.5, phase="map")
+    reg.histogram("lat").observe(0.01, method="get")
+    reg.histogram("lat").observe(0.02, method="get")
+    v = reg.current_values()
+    assert v["req{method=get}"] == 3
+    assert v["req{method=put}"] == 1
+    assert v["depth{phase=map}"] == 7.5
+    assert v["lat{method=get}.count"] == 2
+    assert v["lat{method=get}.sum"] == pytest.approx(0.03)
+
+
+def test_counter_set_total_keeps_monotonicity():
+    reg = MetricsRegistry()
+    c = reg.counter("calls")
+    c.set_total(10)
+    c.set_total(4)   # sloppy publisher: ignored, counters never regress
+    c.set_total(12)
+    assert reg.current_values()["calls"] == 12
+
+
+def test_ring_buckets_one_point_per_period_and_eviction():
+    reg = MetricsRegistry(period_s=1000.0, capacity=8)
+    reg.gauge("g").set(1)
+    assert reg.maybe_sample() is True
+    assert reg.maybe_sample() is False    # same wall bucket: no new point
+    assert len(reg.points()) == 1
+    for i in range(10):
+        reg.maybe_sample(force=True)      # force: one point each
+    assert len(reg.points()) == 8         # capacity bound
+    assert reg.dropped_points >= 2        # eviction counted, not silent
+    ts = reg.timeseries_dict()
+    assert ts["schema"] == 1 and ts["capacity"] == 8
+    assert len(ts["points"]) == 8 and ts["series"]["g"]["kind"] == "gauge"
+
+
+def test_collector_pull_and_errors_counted():
+    reg = MetricsRegistry()
+    reg.add_collector(lambda: {"job.bytes_in": 42, "bad": "string-dropped"})
+
+    def boom():
+        raise RuntimeError("collector must never fail the loop")
+
+    reg.add_collector(boom)
+    v = reg.current_values()
+    assert v["job.bytes_in"] == 42 and "bad" not in v
+    assert reg.collector_errors == 1
+
+
+def test_jobstats_collector_reads_aggregates():
+    from mapreduce_rust_tpu.runtime.metrics import JobStats
+
+    stats = JobStats()
+    stats.bytes_in = 1234
+    stats.host_map_s = 1.5
+    vals = jobstats_collector(stats)()
+    assert vals["job.bytes_in"] == 1234
+    assert vals["job.host_map_s"] == 1.5
+
+
+def test_ship_sample_is_flat_and_fresh():
+    reg = MetricsRegistry()
+    reg.gauge("g").set(3)
+    s = reg.ship_sample()
+    assert set(s) == {"t", "v"} and s["v"]["g"] == 3
+    assert abs(s["t"] - time.time()) < 5
+
+
+def test_global_lifecycle_and_tick():
+    assert active_registry() is None
+    metrics_tick()  # no-op when off
+    reg = start_metrics(period_s=1000.0)
+    try:
+        assert active_registry() is reg
+        reg.gauge("g").set(1)
+        metrics_tick()
+        assert len(reg.points()) == 1
+    finally:
+        assert stop_metrics() is reg
+    assert active_registry() is None
+
+
+def test_stop_metrics_compare_and_clear_spares_a_cohosted_owner():
+    # In-process co-hosted workers: B replaces the global slot after A
+    # started; A's teardown must not tear down B's live registry.
+    a = start_metrics()
+    b = start_metrics()
+    try:
+        assert stop_metrics(a) is None      # not yours anymore: no-op
+        assert active_registry() is b
+        assert stop_metrics(b) is b
+    finally:
+        stop_metrics()
+    assert active_registry() is None
+
+
+def test_concurrent_ticks_sample_each_bucket_once():
+    reg = MetricsRegistry(period_s=0.05, capacity=64)
+    reg.gauge("g").set(1)
+    stop = threading.Event()
+
+    def tick():
+        while not stop.is_set():
+            reg.maybe_sample()
+
+    threads = [threading.Thread(target=tick) for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.5)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    stamps = [p["t"] for p in reg.points()]
+    assert len(stamps) == len(set(stamps)), \
+        "two threads sampled the same wall bucket"
+
+
+def test_registry_config_validation():
+    with pytest.raises(ValueError):
+        MetricsRegistry(period_s=0)
+    with pytest.raises(ValueError):
+        MetricsRegistry(capacity=2)
+    from mapreduce_rust_tpu.config import Config
+
+    with pytest.raises(ValueError, match="metrics_sample_period_s"):
+        Config(metrics_sample_period_s=-1)
+    with pytest.raises(ValueError, match="metrics_ring_points"):
+        Config(metrics_ring_points=2)
+    with pytest.raises(ValueError, match="metrics_port"):
+        Config(metrics_port=-5)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition — format conformance
+# ---------------------------------------------------------------------------
+
+#: One exposition sample line: name{labels} value
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? (-?[0-9.e+-]+|\+Inf|NaN)$'
+)
+
+
+def parse_exposition(text: str) -> dict:
+    """Minimal text-exposition parser: {family: {"type": t, "samples":
+    [(name, labels, value)]}}. Raises on any malformed line — the
+    conformance check IS the parse."""
+    families: dict = {}
+    cur = None
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            fam, _, kind = rest.partition(" ")
+            assert kind in ("counter", "gauge", "histogram", "untyped"), line
+            cur = families.setdefault(fam, {"type": kind, "samples": []})
+            continue
+        if line.startswith("# HELP "):
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"malformed exposition line: {line!r}"
+        name, labels, value = m.groups()
+        fam = re.sub(r"_(bucket|sum|count)$", "", name)
+        owner = families.get(name) or families.get(fam) or cur
+        assert owner is not None, f"sample before any # TYPE: {line!r}"
+        owner["samples"].append((name, labels or "", float(value)))
+    return families
+
+
+def test_prometheus_text_renders_all_three_kinds():
+    reg = MetricsRegistry()
+    reg.counter("rpc.calls", help="total RPCs").inc(5, method="get_map_task")
+    reg.gauge("phase.in_flight").set(2, phase="map")
+    h = reg.histogram("task.duration_s")
+    for v in (0.01, 0.02, 5.0):
+        h.observe(v, phase="map")
+    reg.add_collector(lambda: {"job.bytes_in": 99})
+    text = reg.prometheus_text()
+    fams = parse_exposition(text)
+
+    assert fams["mr_rpc_calls"]["type"] == "counter"
+    assert (
+        "mr_rpc_calls", '{method="get_map_task"}', 5.0
+    ) in fams["mr_rpc_calls"]["samples"]
+
+    assert fams["mr_phase_in_flight"]["type"] == "gauge"
+    assert fams["mr_job_bytes_in"]["type"] == "gauge"
+
+    hist = fams["mr_task_duration_s"]
+    assert hist["type"] == "histogram"
+    buckets = [s for s in hist["samples"] if s[0].endswith("_bucket")]
+    sums = [s for s in hist["samples"] if s[0].endswith("_sum")]
+    counts = [s for s in hist["samples"] if s[0].endswith("_count")]
+    assert buckets and sums and counts
+    # le= labels present, cumulative counts non-decreasing, +Inf == count.
+    les = [re.search(r'le="([^"]+)"', s[1]).group(1) for s in buckets]
+    assert "+Inf" in les
+    cum = [s[2] for s in buckets]
+    assert cum == sorted(cum)
+    assert cum[-1] == counts[0][2] == 3
+    assert sums[0][2] == pytest.approx(5.03)
+
+    assert "# HELP mr_rpc_calls total RPCs" in text.splitlines()
+
+
+def test_prometheus_label_escaping_and_name_mangling():
+    reg = MetricsRegistry()
+    reg.gauge("weird.name-x").set(1, path='a"b\\c')
+    text = reg.prometheus_text()
+    assert 'mr_weird_name_x{path="a\\"b\\\\c"} 1' in text
+    parse_exposition(text)  # and it still parses
+
+
+# ---------------------------------------------------------------------------
+# Scrape endpoint (MetricsHTTPServer)
+# ---------------------------------------------------------------------------
+
+def test_scrape_endpoint_serves_published_text():
+    srv = MetricsHTTPServer(0)  # ephemeral port
+    try:
+        reg = MetricsRegistry()
+        reg.counter("rpc.calls").inc(3)
+        srv.publish(reg.prometheus_text())
+        r = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=5
+        )
+        assert r.status == 200
+        assert r.headers["Content-Type"] == MetricsRegistry.CONTENT_TYPE
+        body = r.read().decode()
+        fams = parse_exposition(body)
+        assert fams["mr_rpc_calls"]["samples"][0][2] == 3.0
+        # Unknown paths 404; bare / serves the same body (scraper probes).
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/nope", timeout=5
+            )
+        r2 = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/", timeout=5
+        )
+        assert r2.read().decode() == body
+    finally:
+        srv.close()
+
+
+def test_scrape_endpoint_publish_is_thread_safe_snapshot():
+    srv = MetricsHTTPServer(0)
+    try:
+        stop = threading.Event()
+
+        def publisher():
+            i = 0
+            while not stop.is_set():
+                srv.publish(f"# TYPE mr_g gauge\nmr_g {i}\n")
+                i += 1
+
+        t = threading.Thread(target=publisher, daemon=True)
+        t.start()
+        for _ in range(20):
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=5
+            ).read().decode()
+            if body.startswith("# metrics"):
+                continue  # pre-first-publish placeholder
+            parse_exposition(body)  # every response is a complete snapshot
+        stop.set()
+        t.join(timeout=5)
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Manifest + flight recorder integration
+# ---------------------------------------------------------------------------
+
+def test_run_job_manifest_carries_timeseries(tmp_path):
+    from mapreduce_rust_tpu.config import Config
+    from mapreduce_rust_tpu.runtime.driver import run_job
+    from mapreduce_rust_tpu.runtime.telemetry import load_manifest
+
+    doc = tmp_path / "doc.txt"
+    doc.write_bytes(b"tiny corpus of words words words " * 200)
+    cfg = Config(
+        map_engine="host",
+        output_dir=str(tmp_path / "out"),
+        manifest_path=str(tmp_path / "manifest.json"),
+        metrics_sample_period_s=0.01,
+    )
+    run_job(cfg, [str(doc)])
+    assert active_registry() is None  # run owns + releases the global slot
+    m = load_manifest(str(tmp_path / "manifest.json"))
+    ts = m["stats"]["timeseries"]
+    assert ts["points"], "even a sub-period run forces one final sample"
+    last = ts["points"][-1]["v"]
+    assert last["job.bytes_in"] == m["stats"]["bytes_in"]
+    assert ts["series"]["job.bytes_in"]["kind"] == "gauge"
+    # metrics_enabled=False: no registry, no block.
+    cfg2 = Config(
+        map_engine="host",
+        output_dir=str(tmp_path / "out2"),
+        manifest_path=str(tmp_path / "manifest2.json"),
+        metrics_enabled=False,
+    )
+    run_job(cfg2, [str(doc)])
+    m2 = load_manifest(str(tmp_path / "manifest2.json"))
+    assert "timeseries" not in m2["stats"]
+
+
+def test_flight_recorder_partial_embeds_ring(tmp_path):
+    from mapreduce_rust_tpu.runtime.trace import (
+        partial_path,
+        start_tracing,
+        stop_tracing,
+        trace_span,
+    )
+
+    path = str(tmp_path / "t.json")
+    part = partial_path(path)
+    tr = start_tracing(tag="w1")
+    try:
+        reg = MetricsRegistry()
+        reg.gauge("g").set(42)
+        reg.maybe_sample(force=True)
+        tr.metrics_registry = reg
+        tr.enable_flight_recorder(part, period_s=1e-6, min_new_events=1)
+        with trace_span("work"):
+            pass
+        assert tr.maybe_snapshot() == part
+    finally:
+        stop_tracing()
+    snap = json.loads(pathlib.Path(part).read_text())
+    assert snap["metadata"]["partial"] is True
+    assert snap["metrics"]["points"][-1]["v"]["g"] == 42
+
+
+# ---------------------------------------------------------------------------
+# Coordinator: renewal-envelope ingestion + metrics RPC (in-process)
+# ---------------------------------------------------------------------------
+
+def _cluster_cfg(tmp_path, **kw):
+    from mapreduce_rust_tpu.config import Config
+
+    defaults = dict(
+        map_n=2, reduce_n=2, worker_n=1,
+        input_dir=str(tmp_path / "in"), work_dir=str(tmp_path / "work"),
+        output_dir=str(tmp_path / "out"),
+    )
+    defaults.update(kw)
+    return Config(**defaults)
+
+
+def test_coordinator_ingests_renewal_envelope_sample(tmp_path):
+    from mapreduce_rust_tpu.coordinator.server import Coordinator
+
+    c = Coordinator(_cluster_cfg(tmp_path))
+    wid = c.get_worker_id()
+    tid = c.get_map_task(wid)
+    # Trailing default: a pre-metrics caller omits the sample — wire-valid.
+    assert c.renew_map_lease(tid, wid) is True
+    assert c.fleet == {}
+    sample = {"t": time.time(), "v": {"worker.bytes_in": 123,
+                                      "worker.tasks_done": 1,
+                                      "junk": "dropped"}}
+    assert c.renew_map_lease(tid, wid, sample) is True
+    assert c.fleet[wid]["v"] == {"worker.bytes_in": 123,
+                                 "worker.tasks_done": 1}
+    # The fleet series land in the registry as per-worker labeled gauges.
+    v = c.registry.current_values()
+    assert v[f"worker.bytes_in{{wid={wid}}}"] == 123
+    # metrics() — the RPC payload: fleet + findings + latest ring point.
+    c._metrics_tick()
+    out = c.metrics()
+    assert out["enabled"] and str(wid) in out["fleet"]
+    assert out["latest"] is not None
+    assert "phase.in_flight{phase=map}" in out["series"]
+
+
+def test_coordinator_envelope_is_defensive(tmp_path):
+    from mapreduce_rust_tpu.coordinator.server import Coordinator
+
+    c = Coordinator(_cluster_cfg(tmp_path))
+    wid = c.get_worker_id()
+    tid = c.get_map_task(wid)
+    c.renew_map_lease(tid, wid, {"v": "not-a-dict"})
+    c.renew_map_lease(tid, wid, "garbage")
+    c.renew_map_lease(tid, -1, {"v": {"x": 1}})   # unregistered wid
+    # A wid this coordinator never issued must not mint fleet entries /
+    # gauge label-sets (unauthenticated RPC param, unbounded otherwise).
+    c.renew_map_lease(tid, 7, {"t": 0, "v": {"x": 1}})
+    assert c.fleet == {}
+    # A confused worker cannot balloon the registry: series capped.
+    huge = {"t": 0, "v": {f"s{i}": i for i in range(500)}}
+    c.renew_map_lease(tid, wid, huge)
+    assert len(c.fleet[wid]["v"]) <= 64
+    # A sample key colliding with a coordinator-owned counter/histogram
+    # name must not crash the renewal handler (the lease is already
+    # renewed): kept in the fleet view, skipped in the registry.
+    c._metrics_tick()  # registers rpc.calls (counter), task.duration_s …
+    assert c.renew_map_lease(tid, wid, {"t": 0, "v": {"rpc.calls": 7}}) \
+        is True
+    assert c.fleet[wid]["v"] == {"rpc.calls": 7}
+
+
+def test_metrics_disabled_keeps_rpcs_wire_valid(tmp_path):
+    from mapreduce_rust_tpu.coordinator.server import Coordinator
+
+    c = Coordinator(_cluster_cfg(tmp_path, metrics_enabled=False))
+    wid = c.get_worker_id()
+    tid = c.get_map_task(wid)
+    assert c.renew_map_lease(tid, wid, {"t": 0, "v": {"x": 1}}) is True
+    assert c.registry is None and c.fleet == {}
+    out = c.metrics()
+    assert out["enabled"] is False and "latest" not in out
+
+
+# ---------------------------------------------------------------------------
+# Streaming doctor units
+# ---------------------------------------------------------------------------
+
+def test_diagnose_live_drops_post_mortem_codes_and_aggregates_fleet():
+    from mapreduce_rust_tpu.analysis.doctor import diagnose_live
+
+    # A live job always has in-flight work: the post-mortem codes
+    # (incomplete-task/chain, run-error) must not fire mid-run.
+    rep = {
+        "uptime_s": 5.0,
+        "totals": {"map": {"reports": 1, "grants": 2}},
+        "tasks": {"map": {"0": {"completed": False, "grants": 1}}},
+        "progress": {"done": False},
+    }
+    fleet = {
+        0: {"v": {"worker.host_map_s": 8.0, "worker.device_wait_s": 0.5}},
+        1: {"v": {"worker.host_map_s": 7.0, "worker.ingest_wait_s": 0.1}},
+    }
+    diag = diagnose_live(rep, lease_timeout_s=60.0, fleet=fleet)
+    codes = {f["code"] for f in diag["findings"]}
+    assert not codes & {"incomplete-task", "incomplete-chain", "run-error",
+                        "no-telemetry"}
+    # Fleet wait-splits aggregate into the shared bottleneck attribution.
+    assert "live-bottleneck" in codes
+    bn = diag["bottleneck"]
+    assert bn["name"] == "host-map"
+
+
+def test_format_live_renders_findings_and_fleet():
+    from mapreduce_rust_tpu.analysis.doctor import format_live
+
+    text = format_live({
+        "findings": [
+            {"severity": "warn", "code": "straggler", "key": "straggler:w0",
+             "message": "w0 slow", "first_seen_s": 4.2, "active": True},
+            {"severity": "info", "code": "live-bottleneck",
+             "message": "scan", "first_seen_s": 1.0, "active": False},
+        ],
+        "fleet": {"0": {"age_s": 0.3, "v": {"worker.tasks_done": 2}}},
+    })
+    assert "straggler" in text and "first seen 4.2s" in text
+    assert "cleared" in text       # inactive finding kept as history
+    assert "w0 sample" in text and "tasks_done=2" in text
+
+
+# ---------------------------------------------------------------------------
+# Live-doctor e2e: chaos slow_scan cluster, straggler observed MID-RUN,
+# scrape endpoint conformance against the same live coordinator.
+# ---------------------------------------------------------------------------
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _env() -> dict:
+    import os
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def test_live_doctor_sees_straggler_before_job_end(tmp_path):
+    """The acceptance scenario: a seeded slow worker (chaos slow_scan)
+    drives a REAL OS-process cluster; `watch --doctor --once --json`
+    polled from the test observes the straggler finding while
+    progress.done is still false; the scrape endpoint answers conformant
+    text exposition mid-run; and after `done` the coordinator manifest's
+    stats.timeseries carries the same series the endpoint served."""
+    docs = tmp_path / "in"
+    docs.mkdir()
+    # 4 docs × a 6 s per-task slowdown on w0: the straggler window (first
+    # slow task completed → job end) stays many seconds wide even when a
+    # loaded machine stretches each watch-subprocess poll to seconds.
+    for i in range(4):
+        (docs / f"doc-{i}.txt").write_bytes(
+            b"the quick brown fox jumps over the lazy dog " * 400
+        )
+    port, mport = _free_port(), _free_port()
+    common = [
+        "--input", str(docs), "--output", str(tmp_path / "out"),
+        "--work", str(tmp_path / "work"), "--port", str(port),
+        "--reduce-n", "3", "--lease-timeout", "8.0",
+        "--lease-check-period", "0.3", "--renew-period", "0.3",
+        "--poll-retry", "0.05",
+    ]
+    env = _env()
+    wenv = dict(env, MR_CHAOS="seed=5;slow_scan:w0:6.0")
+    coord = subprocess.Popen(
+        [sys.executable, "-m", "mapreduce_rust_tpu", "coordinator",
+         "--worker-n", "2", "--manifest", str(tmp_path / "manifest.json"),
+         "--metrics-port", str(mport), *common],
+        env=env, cwd=str(REPO), stderr=subprocess.DEVNULL,
+    )
+    workers = [
+        subprocess.Popen(
+            [sys.executable, "-m", "mapreduce_rust_tpu", "worker",
+             "--engine", "host", *common],
+            env=wenv, cwd=str(REPO), stderr=subprocess.DEVNULL,
+        )
+        for _ in range(2)
+    ]
+    saw_live_straggler = False
+    scrape_text = None
+    ever_connected = False
+    try:
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            r = subprocess.run(
+                [sys.executable, "-m", "mapreduce_rust_tpu", "watch",
+                 "--port", str(port), "--doctor", "--json", "--once"],
+                env=env, cwd=str(REPO), capture_output=True, text=True,
+                timeout=30,
+            )
+            if r.returncode != 0 or not r.stdout.strip():
+                if not ever_connected:
+                    # Coordinator still importing/binding: keep retrying.
+                    time.sleep(0.3)
+                    continue
+                break  # coordinator gone: job over
+            ever_connected = True
+            row = json.loads(r.stdout.strip().splitlines()[-1])
+            assert set(row) >= {"t", "stats", "metrics"}
+            done = (row["stats"].get("progress") or {}).get("done")
+            # Active OR cleared: a finding in the RPC's list mid-run was
+            # surfaced live either way (first_seen is stamped by the
+            # coordinator's tick, not by our poll landing inside the
+            # active window).
+            codes = {
+                f["code"] for f in row["metrics"].get("findings") or []
+            }
+            if "straggler" in codes and not done:
+                saw_live_straggler = True
+                # Scrape while the finding is live — conformance below.
+                scrape = urllib.request.urlopen(
+                    f"http://127.0.0.1:{mport}/metrics", timeout=5
+                )
+                from mapreduce_rust_tpu.runtime.metrics import (
+                    MetricsRegistry,
+                )
+
+                assert (scrape.headers["Content-Type"]
+                        == MetricsRegistry.CONTENT_TYPE)
+                scrape_text = scrape.read().decode()
+                break
+            if done:
+                break
+            time.sleep(0.3)
+        assert saw_live_straggler, \
+            "straggler finding never surfaced while the job was running"
+        rc = coord.wait(timeout=120)
+        assert rc == 0
+        for w in workers:
+            w.wait(timeout=30)
+    finally:
+        for p in [coord, *workers]:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+    # Scrape conformance: parses, all three kinds present.
+    fams = parse_exposition(scrape_text)
+    kinds = {f["type"] for f in fams.values()}
+    assert {"counter", "gauge", "histogram"} <= kinds
+
+    # The endpoint's series match the final manifest's stats.timeseries:
+    # every instrument family scraped exists in the manifest catalog
+    # under the same prom name (collector families are gauges there too).
+    man = json.loads((tmp_path / "manifest-coord.json").read_text())
+    ts = man["stats"]["timeseries"]
+    assert ts["points"] and ts["series"]
+    catalog_proms = set()
+    for key in ts["series"]:
+        name = key.split("{", 1)[0]
+        for suffix in (".count", ".sum"):
+            if name.endswith(suffix):
+                name = name[: -len(suffix)]
+        catalog_proms.add(_prom_name(name))
+    for fam in fams:
+        assert fam in catalog_proms, \
+            f"scraped family {fam} missing from manifest timeseries catalog"
+
+    # The streaming findings landed in the manifest with first-seen
+    # stamps, straggler included, stamped before the job's end.
+    lf = {f["code"]: f for f in man.get("live_findings", [])}
+    assert "straggler" in lf and lf["straggler"]["first_seen_s"] > 0
+
+    # Outputs are exact despite the slow leg (telemetry never touches
+    # the data path).
+    outs = sorted((tmp_path / "out").glob("mr-*.txt"))
+    assert len(outs) == 3

@@ -1,0 +1,195 @@
+"""Perfetto ``track_event`` protobuf export (ISSUE 8 satellite — the
+PR 4 ROADMAP leftover): ``trace merge --format perfetto`` writes a
+``.pftrace`` the bundled wire-format reader re-parses, with JSON staying
+the default. Hand-rolled varint writer, zero new deps — the reader here
+is the conformance oracle."""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from mapreduce_rust_tpu.runtime.perfetto import (
+    TYPE_COUNTER,
+    TYPE_INSTANT,
+    TYPE_SLICE_BEGIN,
+    TYPE_SLICE_END,
+    _varint,
+    iter_packets,
+    write_pftrace,
+)
+from mapreduce_rust_tpu.runtime.trace import merge_traces
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_varint_roundtrip_edges():
+    from mapreduce_rust_tpu.runtime.perfetto import _read_varint
+
+    for n in (0, 1, 127, 128, 300, 2 ** 32, 2 ** 63, 2 ** 64 - 1):
+        buf = _varint(n)
+        val, i = _read_varint(buf, 0)
+        assert (val, i) == (n, len(buf))
+    # Negative ints wrap to uint64 (proto semantics), still parseable.
+    val, _ = _read_varint(_varint(-1), 0)
+    assert val == 2 ** 64 - 1
+
+
+def _events():
+    return [
+        {"ph": "M", "name": "process_name", "pid": 10,
+         "args": {"name": "coord"}},
+        {"ph": "M", "name": "process_name", "pid": 20,
+         "args": {"name": "w1"}},
+        {"ph": "X", "name": "outer", "ts": 0.0, "dur": 100.0,
+         "pid": 10, "tid": 1},
+        {"ph": "X", "name": "inner", "ts": 10.0, "dur": 50.0,
+         "pid": 10, "tid": 1},
+        {"ph": "i", "name": "mark", "ts": 20.0, "pid": 20, "tid": 2},
+        {"ph": "s", "name": "flow", "ts": 5.0, "pid": 10, "tid": 1,
+         "id": "map:0:1"},
+        {"ph": "f", "name": "flow", "ts": 90.0, "pid": 20, "tid": 2,
+         "id": "map:0:1"},
+        {"ph": "C", "name": "host_map.inflight", "ts": 30.0,
+         "pid": 10, "tid": 1, "args": {"scans": 3, "merges": 1.5}},
+    ]
+
+
+def test_write_pftrace_roundtrips_through_reader(tmp_path):
+    out = tmp_path / "t.pftrace"
+    summary = write_pftrace(_events(), str(out))
+    assert out.stat().st_size == summary["bytes"]
+    packets = list(iter_packets(str(out)))
+    assert len(packets) == summary["packets"]
+
+    descs = [p["track_descriptor"] for p in packets
+             if "track_descriptor" in p]
+    events = [p for p in packets if "track_event" in p]
+
+    # Process descriptors carry the merge's track names; thread + counter
+    # tracks parent onto them via uuid.
+    proc_names = {d["process"]["process_name"] for d in descs
+                  if "process" in d}
+    assert {"coord", "w1"} <= proc_names
+    uuids = {d["uuid"] for d in descs}
+    assert all(d.get("parent_uuid") in uuids
+               for d in descs if "parent_uuid" in d)
+    counter_tracks = {d["uuid"]: d["name"] for d in descs
+                      if d.get("counter")}
+    assert sorted(counter_tracks.values()) == [
+        "host_map.inflight.merges", "host_map.inflight.scans",
+    ]
+
+    # Spans become balanced BEGIN/END in nesting order; ts is ns.
+    slices = [p for p in events
+              if p["track_event"]["type"] in (TYPE_SLICE_BEGIN,
+                                              TYPE_SLICE_END)]
+    assert [
+        (p["track_event"]["type"], p["track_event"].get("name"))
+        for p in sorted(slices, key=lambda p: p["timestamp"])
+    ] == [
+        (TYPE_SLICE_BEGIN, "outer"), (TYPE_SLICE_BEGIN, "inner"),
+        (TYPE_SLICE_END, None), (TYPE_SLICE_END, None),
+    ]
+    assert min(p["timestamp"] for p in slices) == 0
+    assert max(p["timestamp"] for p in slices) == 100_000  # 100 us → ns
+
+    # Flow instants share a 64-bit id; the "f" end terminates it.
+    flows = [p["track_event"] for p in events
+             if p["track_event"].get("flow_ids")
+             or p["track_event"].get("terminating_flow_ids")]
+    assert len(flows) == 2
+    start = next(f for f in flows if f.get("flow_ids"))
+    end = next(f for f in flows if f.get("terminating_flow_ids"))
+    assert start["flow_ids"] == end["terminating_flow_ids"]
+
+    # Counters carry their values on per-key tracks.
+    counters = [p["track_event"] for p in events
+                if p["track_event"]["type"] == TYPE_COUNTER]
+    vals = sorted(c.get("counter_value", c.get("double_counter_value"))
+                  for c in counters)
+    assert vals == [1.5, 3]
+    assert all(c["track_uuid"] in counter_tracks for c in counters)
+
+    instants = [p["track_event"] for p in events
+                if p["track_event"]["type"] == TYPE_INSTANT
+                and p["track_event"].get("name") == "mark"]
+    assert len(instants) == 1
+
+
+def test_write_pftrace_converts_balanced_be_pairs(tmp_path):
+    # Tracer emits only "X", but validate_events accepts balanced B/E
+    # from foreign files — the perfetto path must carry them, not drop
+    # them silently.
+    out = tmp_path / "be.pftrace"
+    write_pftrace([
+        {"ph": "B", "name": "legacy", "ts": 1.0, "pid": 1, "tid": 1},
+        {"ph": "E", "name": "legacy", "ts": 9.0, "pid": 1, "tid": 1},
+    ], str(out))
+    evs = [(p["track_event"]["type"], p["track_event"].get("name"))
+           for p in iter_packets(str(out)) if "track_event" in p]
+    assert evs == [(TYPE_SLICE_BEGIN, "legacy"), (TYPE_SLICE_END, None)]
+
+
+def _fake_trace(path, pid, tag, anchor_unix, events):
+    path.write_text(json.dumps({
+        "traceEvents": events,
+        "metadata": {"pid": pid, "tag": tag, "anchor_unix_s": anchor_unix,
+                     "anchor_perf_s": 0.0},
+    }))
+    return str(path)
+
+
+def _two_process_traces(tmp_path):
+    a = _fake_trace(tmp_path / "a.json", 100, "coord", 1000.0, [
+        {"name": "serve", "ph": "X", "ts": 0.0, "dur": 50.0,
+         "pid": 100, "tid": 1},
+    ])
+    b = _fake_trace(tmp_path / "b.json", 200, "w1", 1000.5, [
+        {"name": "task", "ph": "X", "ts": 0.0, "dur": 10.0,
+         "pid": 200, "tid": 1},
+    ])
+    return a, b
+
+
+def test_merge_traces_perfetto_format(tmp_path):
+    a, b = _two_process_traces(tmp_path)
+    out = tmp_path / "merged.pftrace"
+    summary = merge_traces(str(out), [a, b], out_format="perfetto")
+    assert summary["events"] == 2
+    packets = list(iter_packets(str(out)))
+    proc_names = {p["track_descriptor"]["process"]["process_name"]
+                  for p in packets
+                  if "process" in p.get("track_descriptor", {})}
+    assert proc_names == {"coord", "w1"}
+    # Rebased onto one clock: w1's span begins 0.5 s after coord's.
+    begins = {p["track_event"]["name"]: p["timestamp"] for p in packets
+              if p.get("track_event", {}).get("type") == TYPE_SLICE_BEGIN}
+    assert begins["task"] - begins["serve"] == pytest.approx(
+        500_000_000, rel=0.01
+    )
+
+
+def test_merge_unknown_format_rejected(tmp_path):
+    a, b = _two_process_traces(tmp_path)
+    with pytest.raises(ValueError, match="unknown trace merge format"):
+        merge_traces(str(tmp_path / "x"), [a, b], out_format="svg")
+
+
+def test_trace_merge_cli_perfetto_is_jax_free(tmp_path):
+    a, b = _two_process_traces(tmp_path)
+    out = tmp_path / "merged.pftrace"
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; sys.modules['jax'] = None; "
+         "from mapreduce_rust_tpu.__main__ import main; "
+         f"raise SystemExit(main(['trace', 'merge', '--format', 'perfetto', "
+         f"{str(out)!r}, {a!r}, {b!r}]))"],
+        capture_output=True, text=True, timeout=60, cwd=str(REPO),
+    )
+    assert r.returncode == 0, r.stderr
+    assert out.exists()
+    assert "2 events from 2 process(es)" in r.stdout
+    assert len(list(iter_packets(str(out)))) > 0
