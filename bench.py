@@ -80,6 +80,13 @@ def _env_host_workers() -> "int | None":
     return int(v) if v else None
 
 
+def _env_fold_shards() -> "int | None":
+    """--fold-shards rides into subprocess legs as BENCH_FOLD_SHARDS
+    (None = Config auto: 1 below 4 usable cores, else min(4, cores//2))."""
+    v = os.environ.get("BENCH_FOLD_SHARDS")
+    return int(v) if v else None
+
+
 def build_corpus(target_mb: int) -> pathlib.Path:
     out = BENCH_DIR / f"corpus-{target_mb}mb.txt"
     if out.exists() and out.stat().st_size >= target_mb << 20:
@@ -144,6 +151,7 @@ def _zipf_cfg(work: str, out: str, reduce_n: int):
     return Config(
         map_engine=os.environ.get("BENCH_MAP_ENGINE", "host"),
         host_map_workers=_env_host_workers(),
+        fold_shards=_env_fold_shards(),
         host_window_bytes=16 << 20,
         chunk_bytes=1 << 20,
         merge_capacity=1 << 18,        # << the Zipf vocab: constant eviction
@@ -466,6 +474,7 @@ def metrics_overhead_leg(path: str) -> None:
     base = Config(
         map_engine="host",
         host_map_workers=_env_host_workers(),
+        fold_shards=_env_fold_shards(),
         host_window_bytes=16 << 20,
         chunk_bytes=1 << 20,
         merge_capacity=1 << 17,
@@ -675,6 +684,7 @@ def device_leg(path: str) -> None:
     cfg = Config(
         map_engine=os.environ.get("BENCH_MAP_ENGINE", "host"),
         host_map_workers=_env_host_workers(),
+        fold_shards=_env_fold_shards(),
         host_window_bytes=(32 << 20) if on_cpu else (16 << 20),
         chunk_bytes=1 << 20,
         merge_capacity=(1 << 17) if on_cpu else (1 << 18),
@@ -715,6 +725,9 @@ def device_leg(path: str) -> None:
         "host_map_s": round(s.host_map_s, 3),
         "host_glue_s": round(s.host_glue_s, 3),
         "host_workers": s.host_map_workers,
+        "fold_shards": s.fold_shards,
+        "fold_s": round(s.fold_s, 3),
+        "fold_stall_s": round(s.fold_stall_s, 3),
         "scan_wait_s": round(s.scan_wait_s, 3),
         "map_engine": cfg.map_engine,
         "phases": {k: round(v, 3) for k, v in s.phase_seconds.items()},
@@ -895,57 +908,60 @@ def _load_leg_manifest(path, t_start: float, pid: int):
     return None
 
 
-def sweep_host_workers(spec: str) -> None:
-    """`--sweep-host-workers 1,2,4`: one measured device leg per worker
-    count, each leg writing its own run manifest under .bench/sweep/, so
-    scaling curves come from structured files, not scraped logs. Prints
-    ONE JSON line: the curve with per-point GB/s, bottleneck, scan
-    parallelism and the manifest path to diff
-    (`python -m mapreduce_rust_tpu stats run-w1.json run-w4.json`)."""
+def _parse_sweep_counts(spec: str, flag: str) -> list:
     counts = []
     for tok in spec.split(","):
         tok = tok.strip()
         if tok:
             n = int(tok)
             if n < 1:
-                raise SystemExit(f"--sweep-host-workers: bad count {n}")
+                raise SystemExit(f"{flag}: bad count {n}")
             counts.append(n)
     if not counts:
-        raise SystemExit("--sweep-host-workers needs counts, e.g. 1,2,4")
+        raise SystemExit(f"{flag} needs counts, e.g. 1,2,4")
+    return counts
+
+
+def _run_sweep(counts: list, env_var: str, file_prefix: str, point_key: str,
+               metric_label: str, manifest_cfg_key: str, point_stats) -> None:
+    """THE sweep harness (host-worker and fold-shard sweeps share it —
+    one copy, so the anchoring policy / manifest schema cannot drift):
+    one measured device leg per count with `env_var` riding into the
+    subprocess, each leg writing its own run manifest under .bench/sweep/
+    (run-{prefix}{n}.json), so scaling curves come from structured files,
+    not scraped logs. Prints ONE JSON line: the curve with per-point GB/s
+    plus whatever `point_stats(stats_dict)` extracts, and the manifest
+    path to diff (`python -m mapreduce_rust_tpu stats run-w1.json
+    run-w4.json`)."""
     corpus = build_corpus(TARGET_MB)
     sweep_dir = BENCH_DIR / "sweep"
     sweep_dir.mkdir(parents=True, exist_ok=True)
     curve = []
     for n in counts:
         env = dict(os.environ)
-        env["BENCH_HOST_WORKERS"] = str(n)
-        env["BENCH_RUN_MANIFEST"] = str(sweep_dir / f"run-w{n}.json")
+        env[env_var] = str(n)
+        env["BENCH_RUN_MANIFEST"] = str(sweep_dir / f"run-{file_prefix}{n}.json")
         if env.get("BENCH_TRACE"):
             # Per-leg trace files: one shared --trace path would be
             # rewritten by every leg and end up holding only the last.
-            env["BENCH_TRACE"] = str(sweep_dir / f"trace-w{n}.json")
+            env["BENCH_TRACE"] = str(sweep_dir / f"trace-{file_prefix}{n}.json")
         res, err = _run_device_leg(
             corpus, DEVICE_TIMEOUT_S, env, init_timeout_s=PROBE_TIMEOUT_S
         )
-        point: dict = {"workers": n, "manifest": env["BENCH_RUN_MANIFEST"]}
+        point: dict = {point_key: n, "manifest": env["BENCH_RUN_MANIFEST"]}
         if res is None:
             point["error"] = err
         else:
             point["gbs"] = round(res["gbs"], 4)
-            s = res.get("stats") or {}
-            point["bottleneck"] = s.get("bottleneck")
-            point["host_map_s"] = s.get("host_map_s")
-            point["scan_wait_s"] = s.get("scan_wait_s")
-            split = s.get("host_map_split") or {}
-            point["scan_parallelism"] = split.get("scan_parallelism")
+            point.update(point_stats(res.get("stats") or {}))
         curve.append(point)
-        print(f"sweep w={n}: {json.dumps(point)}", file=sys.stderr)
+        print(f"sweep {file_prefix}={n}: {json.dumps(point)}", file=sys.stderr)
     # Anchor strictly to the FIRST requested count: if that leg failed,
     # every speedup is null — a ratio against some other surviving count
     # would silently misstate the scaling claim the field names.
     base = curve[0].get("gbs")
     result = {
-        "metric": "word_count GB/s vs host-map workers "
+        "metric": f"word_count GB/s vs {metric_label} "
                   f"({TARGET_MB}MB corpus, counts {counts})",
         "unit": "GB/s",
         "sweep": curve,
@@ -961,13 +977,54 @@ def sweep_host_workers(spec: str) -> None:
             from mapreduce_rust_tpu.runtime import telemetry
 
             telemetry.write_manifest(mp, telemetry.build_manifest(
-                {"sweep_counts": counts, "target_mb": TARGET_MB},
+                {manifest_cfg_key: counts, "target_mb": TARGET_MB},
                 extra={"kind": "bench_sweep_manifest", "result": result},
             ))
             print(f"sweep manifest: {mp}", file=sys.stderr)
         except Exception as e:  # best-effort, like _write_bench_manifest
             print(f"sweep manifest write failed: {e!r}", file=sys.stderr)
     print(json.dumps(result))
+
+
+def sweep_host_workers(spec: str) -> None:
+    """`--sweep-host-workers 1,2,4`: the scan fan-out scaling curve, one
+    run manifest per worker count (see _run_sweep)."""
+
+    def point_stats(s: dict) -> dict:
+        split = s.get("host_map_split") or {}
+        return {
+            "bottleneck": s.get("bottleneck"),
+            "host_map_s": s.get("host_map_s"),
+            "scan_wait_s": s.get("scan_wait_s"),
+            "scan_parallelism": split.get("scan_parallelism"),
+        }
+
+    _run_sweep(
+        _parse_sweep_counts(spec, "--sweep-host-workers"),
+        "BENCH_HOST_WORKERS", "w", "workers", "host-map workers",
+        "sweep_counts", point_stats,
+    )
+
+
+def sweep_fold_shards(spec: str) -> None:
+    """`--sweep-fold-shards 1,2,4` (ISSUE 9 satellite): the egress-fold
+    scaling curve, one run manifest per shard count (see _run_sweep)."""
+
+    def point_stats(s: dict) -> dict:
+        split = s.get("fold_split") or {}
+        return {
+            "bottleneck": s.get("bottleneck"),
+            "host_glue_s": s.get("host_glue_s"),
+            "fold_stall_s": s.get("fold_stall_s"),
+            "fold_parallelism": split.get("fold_parallelism"),
+            "fold_balance": split.get("balance"),
+        }
+
+    _run_sweep(
+        _parse_sweep_counts(spec, "--sweep-fold-shards"),
+        "BENCH_FOLD_SHARDS", "s", "fold_shards", "fold shards",
+        "sweep_fold_shards", point_stats,
+    )
 
 
 def _free_port() -> int:
@@ -1331,6 +1388,11 @@ def main() -> None:
         "platform": platform,
         "probes": probes,
     }
+    # The measured leg's fold-shard setting rides into the history line
+    # (ISSUE 9 satellite): "the doctor stopped naming host-glue" is only
+    # checkable from history if each row says what fold config produced it.
+    if dev is not None and dev.get("stats"):
+        result["fold_shards"] = dev["stats"].get("fold_shards")
     if micro is not None:
         result["device_micro"] = micro.get("micro")
     if zipf is not None:
@@ -1403,6 +1465,7 @@ def _append_history(result: dict) -> None:
             "vs_baseline": result.get("vs_baseline"),
             "platform": result.get("platform"),
             "doctor_bottleneck": (result.get("doctor") or {}).get("bottleneck"),
+            "fold_shards": result.get("fold_shards"),
             "zipf_gbs": (result.get("zipf") or {}).get("gbs"),
             # Sampler tax (ISSUE 8): a watched trend series (bad
             # direction: up) — None on chaos/sweep rows keeps it clean.
@@ -1561,8 +1624,16 @@ if __name__ == "__main__":
                 f"--host-workers needs a positive integer, got {_workers!r}"
             )
         os.environ["BENCH_HOST_WORKERS"] = _workers
+    _fold = _take_flag(_argv, "--fold-shards")
+    if _fold:
+        if not _fold.isdigit() or int(_fold) < 1:
+            raise SystemExit(
+                f"--fold-shards needs a positive integer, got {_fold!r}"
+            )
+        os.environ["BENCH_FOLD_SHARDS"] = _fold
     _chaos = _take_switch(_argv, "--chaos")
     _sweep = _take_flag(_argv, "--sweep-host-workers")
+    _sweep_fold = _take_flag(_argv, "--sweep-fold-shards")
     sys.argv = [sys.argv[0]] + _argv
     if _chaos:
         try:
@@ -1582,6 +1653,16 @@ if __name__ == "__main__":
         except BaseException as e:  # one JSON line, like the main harness
             print(json.dumps({
                 "metric": "word_count GB/s vs host-map workers",
+                "unit": "GB/s", "sweep": None,
+                "error": f"sweep harness: {e!r}",
+            }))
+            raise SystemExit(1)
+    elif _sweep_fold:
+        try:
+            sweep_fold_shards(_sweep_fold)
+        except BaseException as e:  # one JSON line, like the main harness
+            print(json.dumps({
+                "metric": "word_count GB/s vs fold shards",
                 "unit": "GB/s", "sweep": None,
                 "error": f"sweep harness: {e!r}",
             }))

@@ -126,6 +126,7 @@ def _cfg(args, map_n: int = 1, worker_n: int = 1) -> Config:
         device=args.device,
         map_engine=getattr(args, "map_engine", "device"),
         host_map_workers=getattr(args, "host_workers", None),
+        fold_shards=getattr(args, "fold_shards", None),
         sharded_stream=getattr(args, "sharded", False),
         checkpoint_every_groups=getattr(args, "checkpoint_every", 0),
         resume=getattr(args, "resume", False),
@@ -496,6 +497,14 @@ def main(argv: list[str] | None = None) -> int:
                    "bit-identical for any value. The manifest's "
                    "host_map_split (see the stats subcommand) shows whether "
                    "scan, glue or device is the ceiling at this setting")
+    p.add_argument("--fold-shards", type=int, default=None, dest="fold_shards",
+                   help="host-map engine egress-fold shards (default: auto — "
+                   "1 below 4 usable cores, else min(4, cores//2); 1 = the "
+                   "inline fold). With S>1 the dictionary splits into S "
+                   "key-hash-disjoint shards, each folded by its own thread "
+                   "from pre-partitioned native scan output; outputs stay "
+                   "bit-identical for any value. The manifest's fold_split "
+                   "shows per-shard balance and fold backpressure")
     p.add_argument("--sharded", action="store_true", dest="sharded",
                    help="with --mesh: sequence-parallel ingestion — the byte "
                    "stream is cut at arbitrary offsets across chips and a "

@@ -55,6 +55,7 @@ WATCHED_METRICS: dict = {
     "stats.ingest_wait_s": ("up", 0.50),
     "stats.device_wait_s": ("up", 0.50),
     "stats.host_glue_s": ("up", 0.50),
+    "stats.fold_stall_s": ("up", 0.50),
     "stats.scan_wait_s": ("up", 0.50),
     "stats.all_to_all_s": ("up", 0.50),
     "stats.compile.total_s": ("up", 1.00),
@@ -63,6 +64,7 @@ WATCHED_METRICS: dict = {
     "stats.spilled_keys": ("up", 1.00),
     "stats.histograms.host_map.scan_s.p95": ("up", 0.50),
     "stats.histograms.host_map.glue_s.p95": ("up", 0.50),
+    "stats.histograms.host_map.fold_s.p95": ("up", 0.50),
     "stats.histograms.a2a.round_s.p95": ("up", 0.50),
     "stats.histograms.device.drain_s.p95": ("up", 0.50),
 }
@@ -146,6 +148,15 @@ def _bottleneck_attribution(stats: dict) -> dict:
         "host-map": scan or 0.0,
         "host-glue": stats.get("host_glue_s", 0.0) or 0.0,
     }
+    # Sharded fold (ISSUE 9): with S > 1 fold threads own the dictionary
+    # fold, so "the fold is the ceiling" reads as router backpressure
+    # (fold_stall_s), exactly mirroring JobStats.bottleneck. Live
+    # fleet-aggregated stats carry no fold_shards field — there the mere
+    # presence of fold stall arms the component.
+    if (stats.get("fold_shards") or 0) > 1 or (
+        "fold_shards" not in stats and (stats.get("fold_stall_s") or 0) > 0
+    ):
+        legacy["host-fold"] = stats.get("fold_stall_s", 0.0) or 0.0
     name, val = max(legacy.items(), key=lambda kv: kv[1])
     primary = name if val > 0 else "balanced"
     extended = dict(legacy)
@@ -275,6 +286,22 @@ def diagnose(manifest: dict, job_report: "dict | None" = None,
                  f"fair share of output bytes ({parts['max']} of mean "
                  f"{parts['mean']:.0f}) — keys hash-route unevenly; raise "
                  "reduce_n or revisit the partition key")
+    fold_split = stats.get("fold_split") or {}
+    fsk = _skew_score(fold_split.get("per_shard_s"))
+    if fsk is not None:
+        skew["fold_shard_s"] = fsk
+        if (
+            fsk["score"] and fsk["score"] > 1.75
+            and (fold_split.get("fold_s") or 0.0) > 0.2
+        ):
+            find("warn", "fold-shard-skew",
+                 f"hottest fold shard spent {fsk['score']:.1f}x the mean "
+                 f"fold time ({fsk['max']:.2f}s of mean {fsk['mean']:.2f}s "
+                 f"across {fold_split.get('shards')} shards) — the key-hash "
+                 "load is imbalanced, so one fold thread carries the egress "
+                 "fold serially; more fold_shards won't help until the hot "
+                 "keys spread (check for a dominant window or a skewed "
+                 "vocabulary)")
     shards = _skew_score(stats.get("mesh_shard_rows"))
     if shards is not None:
         skew["mesh_shard_rows"] = shards
@@ -473,7 +500,8 @@ _POST_MORTEM_CODES = frozenset({
 #: _bottleneck_attribution understands (worker series are prefixed;
 #: strip to the JobStats field name).
 _WAIT_FIELDS = ("ingest_wait_s", "device_wait_s", "host_map_s",
-                "host_glue_s", "scan_wait_s", "all_to_all_s")
+                "host_glue_s", "fold_s", "fold_stall_s", "scan_wait_s",
+                "all_to_all_s")
 
 
 def diagnose_live(stats_rpc: dict, lease_timeout_s: "float | None" = None,

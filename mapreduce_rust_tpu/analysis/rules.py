@@ -1179,6 +1179,115 @@ class NondeterministicPartitionRule(ProgramRule):
                 )
 
 
+class CrossShardFoldRule(ProgramRule):
+    """No fold mutations into a DIFFERENT shard's dictionary (rule 12).
+
+    The sharded egress fold (ISSUE 9) holds exactly one invariant the
+    sanitizer can only check at runtime: a function that was handed shard
+    index ``i`` folds into shard ``i``'s dictionary and no other — a
+    ``shards[j]`` mutation with a foreign index splits one key's dedup and
+    collision state across two dictionaries, and the corruption is silent
+    until an egress diff. This rule checks it statically: inside any
+    function with a shard-index parameter (``shard``/``shard_idx``/
+    ``shard_index``/``shard_i``/``s`` — the fold plane's naming), a
+    dictionary mutator (``add_scanned_raw``/``add_scanned``/``add_words``/
+    ``add_text``/``merge``) whose receiver is — or aliases, via reaching
+    definitions (the PR 7 dataflow layer) — a subscript into a
+    shard container (any name mentioning ``shard``) must index it with an
+    expression that MENTIONS the shard parameter. The same applies to a
+    ``shards[j]`` handed straight to a ``fold``-named helper (the
+    one-call-hop shape ``fold_into(self.shards[j], ...)``). Precision over
+    recall: ``shards[s]``, aliases of it, and receivers that arrive as
+    plain parameters stay silent.
+    """
+
+    name = "cross-shard-fold"
+    summary = "a shard-indexed function folds only into its own shard"
+
+    _MUTATORS = ("add_scanned_raw", "add_scanned", "add_words", "add_text",
+                 "merge")
+    _IDX_PARAMS = ("shard", "shard_idx", "shard_index", "shard_i", "s")
+
+    def _shard_param(self, fu) -> "str | None":
+        a = fu.node.args
+        for arg in a.posonlyargs + a.args + a.kwonlyargs:
+            if arg.arg in self._IDX_PARAMS:
+                return arg.arg
+        return None
+
+    @staticmethod
+    def _shard_subscript(expr) -> "ast.Subscript | None":
+        if isinstance(expr, ast.Subscript) \
+                and "shard" in qualname(expr.value).lower():
+            return expr
+        return None
+
+    @staticmethod
+    def _mentions_param(expr, param: str) -> bool:
+        return any(
+            isinstance(n, ast.Name) and n.id == param for n in ast.walk(expr)
+        )
+
+    def run_program(self, program):
+        from mapreduce_rust_tpu.analysis.dataflow import origins
+
+        for fu in program.functions:
+            param = self._shard_param(fu)
+            if param is None:
+                continue
+            defs = reach = None
+            for n in program._own_walk(fu.node):
+                if not isinstance(n, ast.Call):
+                    continue
+                if isinstance(n.func, ast.Attribute) \
+                        and n.func.attr in self._MUTATORS:
+                    recv = n.func.value
+                    subs = []
+                    direct = self._shard_subscript(recv)
+                    if direct is not None:
+                        subs.append(direct)
+                    elif isinstance(recv, ast.Name):
+                        if defs is None:
+                            defs, reach = fu.rd
+                        for o in origins(fu.cfg, defs, reach, recv):
+                            so = (
+                                self._shard_subscript(o)
+                                if o is not None else None
+                            )
+                            if so is not None:
+                                subs.append(so)
+                    for sub in subs:
+                        if not self._mentions_param(sub.slice, param):
+                            yield self.finding(
+                                fu.path, n,
+                                f"{fu.qualname} received shard index "
+                                f"{param!r} but mutates a shard dictionary "
+                                "selected by a different index — one key's "
+                                "dedup/collision state would silently "
+                                "split across two shard dictionaries; fold "
+                                f"only into the shard {param!r} names "
+                                "(cross-shard work goes back through the "
+                                "router)",
+                            )
+                            break
+                    continue
+                # One-call-hop shape: shards[j] handed to a fold helper.
+                if "fold" not in _last_segment(qualname(n.func)).lower():
+                    continue
+                for arg in n.args:
+                    sub = self._shard_subscript(arg)
+                    if sub is not None \
+                            and not self._mentions_param(sub.slice, param):
+                        yield self.finding(
+                            fu.path, n,
+                            f"{fu.qualname} received shard index {param!r} "
+                            "but hands a DIFFERENT shard's dictionary to a "
+                            "fold helper — the callee will mutate a shard "
+                            "this thread does not own (cross-shard-fold)",
+                        )
+                        break
+
+
 ALL_RULES: list[Rule] = [
     StatsOwnershipRule(),
     ExecutorTeardownRule(),
@@ -1200,4 +1309,5 @@ PROGRAM_RULES: list[ProgramRule] = [
     BlockingInAsyncRule(),
     BackendInitInProbeRule(),
     NondeterministicPartitionRule(),
+    CrossShardFoldRule(),
 ]

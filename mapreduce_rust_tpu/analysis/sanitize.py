@@ -142,6 +142,40 @@ def new_dictionary(cfg=None, **kwargs) -> Dictionary:
     return cls(**kwargs)
 
 
+def check_shard_route(keys, n_shards: int, shard_index: int) -> None:
+    """Called by the fold plane's shard threads when sanitizing: every key
+    handed to fold shard ``shard_index`` must actually route there
+    (``shard_of_packed(packed, S) == shard_index``). The per-shard
+    dictionary's owner-thread assert catches a fold from the WRONG THREAD;
+    this catches the complementary bug — a router that sends a key to the
+    wrong shard's queue, where the right thread would fold it into the
+    wrong shard and silently split that key's dedup/collision state across
+    two dictionaries. Vectorized (one numpy pass per routed slice), and
+    only ever called under the sanitizer."""
+    import numpy as np
+
+    from mapreduce_rust_tpu.runtime.dictionary import shard_ids_of_packed
+
+    if len(keys) == 0:
+        return
+    keys = np.asarray(keys)
+    packed = (
+        keys[:, 0].astype(np.uint64) << np.uint64(32)
+    ) | keys[:, 1].astype(np.uint64)
+    routed = shard_ids_of_packed(packed, n_shards)
+    wrong = routed != np.uint64(shard_index)
+    if wrong.any():
+        i = int(np.nonzero(wrong)[0][0])
+        raise SanitizerError(
+            f"fold shard {shard_index} received key "
+            f"({int(keys[i, 0])}, {int(keys[i, 1])}) which routes to shard "
+            f"{int(routed[i])} of {n_shards} — the "
+            "router mis-partitioned a scan result; that key's dedup and "
+            "collision state would silently split across two shard "
+            "dictionaries"
+        )
+
+
 def check_arena_owner(owner_pid: int, owner_tid: int) -> None:
     """Called by native/host._buffers on arena reuse when sanitizing: a
     scratch arena observed under a different (pid, tid) than the one that

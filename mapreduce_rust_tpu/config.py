@@ -66,6 +66,22 @@ class Config:
                                     # while ONE consumer folds results in
                                     # window order — outputs are
                                     # bit-identical for any worker count.
+    fold_shards: Optional[int] = None  # host-map engine egress-fold shards
+                                    # (ISSUE 9). None = auto (1 below 4
+                                    # usable cores, else min(4, cores // 2));
+                                    # 1 = the legacy inline fold on the
+                                    # consumer thread. With S > 1 the
+                                    # dictionary splits into S key-hash-
+                                    # disjoint shards (shard = packed key
+                                    # % S), each owned by exactly ONE fold
+                                    # thread; the native scan emits
+                                    # pre-partitioned per-shard buffers and
+                                    # the router hands each shard its slice,
+                                    # so no dictionary state is ever touched
+                                    # by two threads. Outputs are
+                                    # bit-identical for any (host_map_workers,
+                                    # fold_shards) pair — the device merge
+                                    # stream stays in exact scan order.
     host_update_cap: int = 1 << 16  # fixed per-merge update capacity of the
                                     # host engine; windows with more uniques
                                     # are split across several merges. Fixed
@@ -260,6 +276,8 @@ class Config:
             raise ValueError(f"unknown map_engine {self.map_engine!r}")
         if self.host_map_workers is not None and self.host_map_workers < 1:
             raise ValueError("host_map_workers must be >= 1 (or None for auto)")
+        if self.fold_shards is not None and self.fold_shards < 1:
+            raise ValueError("fold_shards must be >= 1 (or None for auto)")
         if self.rpc_timeout_s <= 0:
             raise ValueError("rpc_timeout_s must be positive")
         if self.flight_record_period_s <= 0:
@@ -311,6 +329,24 @@ class Config:
         except (AttributeError, OSError):  # non-Linux
             n = os.cpu_count() or 1
         return max(n - 1, 1)
+
+    def effective_fold_shards(self) -> int:
+        """Resolved egress-fold shard count for the host-map engine. The
+        explicit knob wins; auto stays at 1 (the inline fold, zero queue
+        hops) below 4 usable cores — a fold thread there would just
+        oversubscribe the scan workers — and takes min(4, cores // 2)
+        otherwise: fold work is Python/numpy-bound per shard, so shards
+        beyond ~half the cores only trade scan parallelism for idle fold
+        threads. ``--fold-shards`` overrides for sweeps."""
+        if self.fold_shards:
+            return max(int(self.fold_shards), 1)
+        try:
+            n = len(os.sched_getaffinity(0))
+        except (AttributeError, OSError):  # non-Linux
+            n = os.cpu_count() or 1
+        if n < 4:
+            return 1
+        return min(4, n // 2)
 
     def effective_partial_capacity(self) -> int:
         """The per-chunk distinct-key capacity both stream paths must share

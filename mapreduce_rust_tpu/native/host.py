@@ -76,6 +76,16 @@ def get_lib() -> ctypes.CDLL | None:
                 ctypes.POINTER(ctypes.c_uint32),
                 ctypes.c_int64,
             ]
+            lib.mr_scan_count_sharded.restype = ctypes.c_int64
+            lib.mr_scan_count_sharded.argtypes = [
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint32),
+                ctypes.POINTER(ctypes.c_uint32),
+                ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int64,
+            ]
         except (OSError, AttributeError) as e:
             # AttributeError: a stale .so (fresh mtime, old ABI) missing a
             # newer symbol must engage the Python fallback, not crash.
@@ -206,6 +216,9 @@ def _buffers(n: int, max_words: int):
             np.empty(max(max_words, 1 << 18), dtype=np.uint32),
             np.empty(max(max_words, 1 << 18), dtype=np.uint32),
             np.empty(max(max_words, 1 << 18), dtype=np.uint32),
+            # grouped->scan position map of the sharded scan (ISSUE 9);
+            # rides in the arena so the gauge prices the sharded engine too
+            np.empty(max(max_words, 1 << 18), dtype=np.int64),
         )
         key = id(bufs[0])
         with _arena_lock:
@@ -243,7 +256,7 @@ def scan_count_raw(
     if n == 0:
         return empty
     max_words = n // 2 + 2
-    words_buf, ends, k1, k2, counts = _buffers(n, max_words)
+    words_buf, ends, k1, k2, counts, _pos = _buffers(n, max_words)
     count = lib.mr_scan_count(
         buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), n,
         _cpclass().ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
@@ -268,6 +281,70 @@ def scan_count_raw(
     )
 
 
+def scan_count_sharded_raw(
+    data: "bytes | np.ndarray", n_shards: int,
+) -> "tuple[bytes, np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray] | None":
+    """Sharded fused scan (ISSUE 9): like :func:`scan_count_raw` but the
+    unique-word outputs come back GROUPED by fold shard (shard = packed
+    key % n_shards, scan order preserved within a shard), plus
+
+    - ``pos``          int64[n] — original scan index of grouped word i
+      (the driver scatters keys/counts back to exact scan order for the
+      device merge, keeping outputs bit-identical to the unsharded path);
+    - ``shard_counts`` int64[n_shards] — uniques per shard, so shard s's
+      slice is rows [cum[s], cum[s+1]) and its word bytes are one
+      contiguous span of the returned buffer.
+
+    Returns None when the native lib is unavailable (callers fall back to
+    the pure-Python scan + per-shard selection)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    shard_counts = np.zeros(max(int(n_shards), 1), dtype=np.int64)
+    empty = (
+        b"",
+        np.empty(0, dtype=np.int64),
+        np.empty((0, 2), dtype=np.uint32),
+        np.empty(0, dtype=np.uint32),
+        np.empty(0, dtype=np.int64),
+        shard_counts,
+    )
+    buf = data if isinstance(data, np.ndarray) else np.frombuffer(data, dtype=np.uint8)
+    buf = np.ascontiguousarray(buf, dtype=np.uint8)  # views stay zero-copy
+    n = int(buf.size)
+    if n == 0:
+        return empty
+    max_words = n // 2 + 2
+    words_buf, ends, k1, k2, counts, pos = _buffers(n, max_words)
+    count = lib.mr_scan_count_sharded(
+        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), n,
+        _cpclass().ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        int(n_shards),
+        words_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        ends.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        k1.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        k2.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        counts.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        pos.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        shard_counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        max_words,
+    )
+    if count < 0:  # cannot happen with max_words = n//2+2; belt and braces
+        return None
+    count = int(count)
+    if not count:
+        return empty
+    raw = words_buf[: int(ends[count - 1])].tobytes()
+    return (
+        raw,
+        ends[:count].copy(),
+        np.stack([k1[:count], k2[:count]], axis=1),
+        counts[:count].copy(),
+        pos[:count].copy(),
+        shard_counts,
+    )
+
+
 def scan_unique_raw(data: bytes) -> tuple[bytes, np.ndarray, np.ndarray] | None:
     """(concatenated unique words, int64[n] exclusive end offsets,
     uint32[n,2] hash pairs) — or None when the native lib is unavailable.
@@ -280,7 +357,7 @@ def scan_unique_raw(data: bytes) -> tuple[bytes, np.ndarray, np.ndarray] | None:
         return b"", np.empty(0, dtype=np.int64), np.empty((0, 2), dtype=np.uint32)
     n = len(data)
     max_words = n // 2 + 2
-    words_buf, ends, k1, k2, _counts = _buffers(n, max_words)
+    words_buf, ends, k1, k2, _counts, _pos = _buffers(n, max_words)
     count = lib.mr_scan_unique(
         data, n,
         words_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),

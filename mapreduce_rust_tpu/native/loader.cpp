@@ -456,6 +456,104 @@ int64_t mr_scan_count(const uint8_t* buf, int64_t len,
   return n_unique;
 }
 
+// Fold-shard routing (ISSUE 9): xor-shift + splitmix64-multiplier bit mix
+// of the packed key, then high-bits modulo — MUST stay identical to
+// runtime/dictionary.shard_of_packed (the Python fallback, sanitizer route
+// check and egress lookup), or a key's folds silently split across two
+// shards. The mix exists because bare `packed % S` is the low bits of the
+// h2 polynomial lane, where correlated token classes collapse onto one
+// shard (zero fold parallelism).
+inline int64_t shard_of_packed(uint64_t packed, int64_t n_shards) {
+  uint64_t x = (packed ^ (packed >> 33)) * 0x9E3779B97F4A7C15ull;
+  return (int64_t)((x >> 32) % (uint64_t)n_shards);
+}
+
+// Sharded variant of mr_scan_count (ISSUE 9): the same fused
+// normalize+tokenize+dedupe+count pass, then a stable counting sort that
+// groups the unique-word outputs by fold shard (shard_of_packed above —
+// shared with the Python fold plane). Outputs:
+//   words/ends/k1/k2/counts — grouped by shard, scan order WITHIN a shard
+//     (ends stay global exclusive offsets over the grouped words buffer,
+//     so shard s's bytes are one contiguous slice);
+//   pos_out[g]            — the ORIGINAL scan index of grouped word g: the
+//     router scatters keys/counts back to exact scan order for the device
+//     merge stream, which is what keeps outputs bit-identical to the
+//     unsharded engine (merge/evict order never changes);
+//   shard_counts_out[s]   — unique words routed to shard s.
+// The grouping pass is O(n_unique + word bytes) against a scan that
+// already touched every input byte — the per-word Python routing loop it
+// replaces was the host-glue bottleneck this kernel exists to kill.
+// Returns the unique-word count, or -1 if max_words was too small.
+int64_t mr_scan_count_sharded(const uint8_t* buf, int64_t len,
+                              const uint8_t* cpclass,  // [0x110000]
+                              int64_t n_shards,
+                              uint8_t* words_out, int64_t* ends_out,
+                              uint32_t* k1_out, uint32_t* k2_out,
+                              uint32_t* counts_out,
+                              int64_t* pos_out, int64_t* shard_counts_out,
+                              int64_t max_words) {
+  for (int64_t s = 0; s < n_shards; ++s) shard_counts_out[s] = 0;
+  int64_t n = mr_scan_count(buf, len, cpclass, words_out, ends_out,
+                            k1_out, k2_out, counts_out, max_words);
+  if (n <= 0) return n;
+  if (n_shards <= 1) {
+    shard_counts_out[0] = n;
+    for (int64_t i = 0; i < n; ++i) pos_out[i] = i;
+    return n;
+  }
+  std::vector<int64_t> shard_of((size_t)n);
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t packed = (((uint64_t)k1_out[i]) << 32) | k2_out[i];
+    int64_t s = shard_of_packed(packed, n_shards);
+    shard_of[i] = s;
+    ++shard_counts_out[s];
+  }
+  // Stable grouped position per scan index (counting sort: scan order is
+  // first-occurrence order, and the fold's collision policy — first word
+  // wins — depends on preserving it within each shard).
+  std::vector<int64_t> cur((size_t)n_shards, 0);
+  for (int64_t s = 1; s < n_shards; ++s)
+    cur[s] = cur[s - 1] + shard_counts_out[s - 1];
+  std::vector<int64_t> gpos((size_t)n);
+  for (int64_t i = 0; i < n; ++i) gpos[i] = cur[shard_of[i]]++;
+  // Permute keys/counts; record the inverse (grouped -> scan) for the
+  // router's device-order scatter.
+  std::vector<uint32_t> tk1((size_t)n), tk2((size_t)n), tc((size_t)n);
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t g = gpos[i];
+    tk1[g] = k1_out[i];
+    tk2[g] = k2_out[i];
+    tc[g] = counts_out[i];
+    pos_out[g] = i;
+  }
+  std::memcpy(k1_out, tk1.data(), sizeof(uint32_t) * (size_t)n);
+  std::memcpy(k2_out, tk2.data(), sizeof(uint32_t) * (size_t)n);
+  std::memcpy(counts_out, tc.data(), sizeof(uint32_t) * (size_t)n);
+  // Permute the concatenated word bytes into shard-grouped order and
+  // rebuild the (still global, still exclusive) end offsets.
+  int64_t words_len = ends_out[n - 1];
+  std::vector<int64_t> gends((size_t)n);
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t b = i ? ends_out[i - 1] : 0;
+    gends[gpos[i]] = ends_out[i] - b;  // lengths first, grouped
+  }
+  int64_t acc = 0;
+  for (int64_t g = 0; g < n; ++g) {
+    acc += gends[g];
+    gends[g] = acc;
+  }
+  std::vector<uint8_t> wtmp((size_t)words_len);
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t b = i ? ends_out[i - 1] : 0;
+    int64_t g = gpos[i];
+    int64_t gb = g ? gends[g - 1] : 0;
+    std::memcpy(wtmp.data() + gb, words_out + b, (size_t)(ends_out[i] - b));
+  }
+  std::memcpy(words_out, wtmp.data(), (size_t)words_len);
+  std::memcpy(ends_out, gends.data(), sizeof(int64_t) * (size_t)n);
+  return n;
+}
+
 // Normalize raw UTF-8 in one pass (the C replacement for
 // core/normalize.normalize_unicode — byte-exact by contract, proven by
 // tests/test_native.py):

@@ -75,6 +75,36 @@ def remove_run_files(runs: list) -> None:
     runs.clear()
 
 
+_SHARD_MIX = 0x9E3779B97F4A7C15  # splitmix64 finalizer multiplier
+_U64 = (1 << 64) - 1
+
+
+def shard_of_packed(packed: int, n_shards: int) -> int:
+    """THE fold-shard routing function (ISSUE 9): xor-shift + odd-multiply
+    bit mix of the packed key, then high-bits modulo. One definition
+    shared by the native kernel (loader.cpp mr_scan_count_sharded computes
+    the identical expression), the Python fallback scan
+    (:func:`shard_ids_of_packed`), the sanitizer's route check and the
+    egress lookup — a second copy that drifted would silently split a
+    key's folds across two shards. The mix matters: a bare ``packed % S``
+    is just the low bits of the h2 polynomial lane, and structurally
+    correlated token classes (e.g. equal-length doubled-letter words)
+    collapse onto one shard there, zeroing fold parallelism."""
+    packed = int(packed) & _U64
+    x = ((packed ^ (packed >> 33)) * _SHARD_MIX) & _U64
+    return (x >> 32) % int(n_shards)
+
+
+def shard_ids_of_packed(packed, n_shards: int):
+    """Vectorized :func:`shard_of_packed` over a uint64 array — the
+    Python-fallback router's and the sanitizer route check's shared
+    implementation (numpy uint64 arithmetic wraps exactly like the C
+    kernel's)."""
+    packed = np.asarray(packed, dtype=np.uint64)
+    x = (packed ^ (packed >> np.uint64(33))) * np.uint64(_SHARD_MIX)
+    return (x >> np.uint64(32)) % np.uint64(n_shards)
+
+
 class Dictionary:
     """hash pair → word bytes, built incrementally at ingest.
 
@@ -489,3 +519,80 @@ class Dictionary:
                     d._fresh_keys.append(packed)
                     d._fresh_lens.append(len(w))
         return d
+
+
+class ShardedDictionary:
+    """Key-hash-sharded egress dictionary (ISSUE 9): S independent
+    :class:`Dictionary` shards, each owned by exactly one fold thread of
+    the host-map engine's fold plane (runtime/driver._FoldShardPlane),
+    merged only at egress.
+
+    Shards are key-DISJOINT by construction — a key lives on shard
+    ``shard_of_packed(packed, S)`` and nowhere else — so no cross-shard
+    dedup exists and ``iter_sorted`` is a plain k-way interleave of the
+    per-shard sorted streams (each shard's runs + RAM tier ride inside its
+    own ``Dictionary.iter_sorted``, so the spill tiers compose for free).
+    Collision accounting, word totals and spill-run counts aggregate over
+    the shards; collision ORDER across shards is not meaningful (only the
+    count is observable downstream).
+
+    Mutations go through the shards directly (the fold plane holds each
+    shard and folds into it on its owner thread); this wrapper exposes only
+    the READ/lifecycle surface run_job's finalize paths consume. It is a
+    single-process host-engine structure: the checkpoint/multihost
+    ``save``/``merge`` persistence contract stays on plain Dictionary
+    (those paths never construct a sharded instance — run_job gates on it).
+    """
+
+    def __init__(self, shards: "list[Dictionary]") -> None:
+        if not shards:
+            raise ValueError("ShardedDictionary needs at least one shard")
+        self.shards = list(shards)
+
+    @property
+    def n_shards(self) -> int:
+        return len(self.shards)
+
+    def shard_of(self, k1: int, k2: int) -> int:
+        return shard_of_packed((k1 << 32) | k2, len(self.shards))
+
+    def __len__(self) -> int:
+        return sum(len(s) for s in self.shards)
+
+    @property
+    def collisions(self) -> list:
+        return [c for s in self.shards for c in s.collisions]
+
+    @property
+    def spilled(self) -> bool:
+        return any(s.spilled for s in self.shards)
+
+    @property
+    def run_count(self) -> int:
+        return sum(s.run_count for s in self.shards)
+
+    def remove_runs(self) -> None:
+        for s in self.shards:
+            s.remove_runs()
+
+    def lookup(self, k1: int, k2: int) -> "bytes | None":
+        return self.shards[self.shard_of(k1, k2)].lookup(k1, k2)
+
+    def items(self):
+        """RAM-resident entries across all shards; each shard raises its
+        own spilled-API guard (same contract as Dictionary.items)."""
+        import itertools
+
+        return itertools.chain.from_iterable(s.items() for s in self.shards)
+
+    def iter_sorted(self):
+        """(packed, k1, k2, word) over ALL shards in ascending packed-key
+        order — the same contract Dictionary.iter_sorted serves, so the
+        streaming merge-join egress is shard-count-blind. Shards are
+        key-disjoint, hence a dedup-free heap interleave of per-shard runs
+        (each itself a runs+RAM merge)."""
+        import heapq
+
+        return heapq.merge(
+            *(s.iter_sorted() for s in self.shards), key=lambda t: t[0]
+        )

@@ -70,9 +70,11 @@ from mapreduce_rust_tpu.ops.tokenize import tokenize_and_hash
 from mapreduce_rust_tpu.runtime.chunker import chunk_stream, list_inputs
 from mapreduce_rust_tpu.runtime.dictionary import (
     Dictionary,
+    ShardedDictionary,
     new_run_token,
     remove_run_files,
 )
+from mapreduce_rust_tpu.runtime.histogram import Histogram
 from mapreduce_rust_tpu.runtime.metrics import (
     JobStats,
     jobstats_collector,
@@ -842,6 +844,246 @@ def _pack_update(keys: np.ndarray, values: np.ndarray, cap: int) -> np.ndarray:
     return flat
 
 
+class _FoldShardPlane:
+    """The sharded egress fold (ISSUE 9): S fold threads, each the SOLE
+    owner of one key-hash-disjoint dictionary shard
+    (runtime/dictionary.ShardedDictionary), fed per-window per-shard
+    slices by the host-map router over bounded queues.
+
+    Ownership discipline — the refactor the PR 3 sanitizer makes
+    mechanically checkable: the router thread never touches shard state
+    (it only slices read-only scan results and enqueues); a fold thread
+    never touches another shard's queue or dictionary; each shard
+    dictionary's owner is handed to its fold thread at start
+    (``set_owner``), so under ``MR_SANITIZE=1`` a fold from the wrong
+    thread raises at the write site and a mis-ROUTED key fails the
+    vectorized ``check_shard_route`` assert before it can split a key's
+    dedup state across shards.
+
+    Failure containment: a fold thread that raises records its error,
+    flips the shared poison flag and keeps DRAINING its queue (discarding)
+    until the sentinel — the router's bounded ``put`` can therefore never
+    deadlock against a dead consumer; the router surfaces the recorded
+    error at its next route or at ``finish``. ``abort`` (exception-path
+    teardown) poisons every shard, forces sentinels past full queues and
+    reaps the threads without ever blocking forever.
+    """
+
+    _SENTINEL = object()
+
+    def __init__(self, cfg: Config, stats: JobStats, shards) -> None:
+        import queue
+        import threading
+
+        from mapreduce_rust_tpu.analysis.sanitize import sanitize_enabled
+
+        self.n = len(shards)
+        self.stats = stats
+        self.shards = shards
+        self._sanitize = sanitize_enabled(cfg)
+        # Bounded per-shard queues: each entry pins one window's grouped
+        # scan arrays (shared read-only across shards — slices are views),
+        # so fold-plane memory stays O(depth × window result), never
+        # O(corpus) — the same flat-memory contract as the scan budget.
+        self.queues = [queue.Queue(maxsize=8) for _ in range(self.n)]
+        self.errors: list = [None] * self.n
+        self.poisoned = threading.Event()
+        self.fold_s = [0.0] * self.n
+        self.idle_s = [0.0] * self.n
+        self.hists = [Histogram() for _ in range(self.n)]
+        self.stall_s = 0.0  # router side: blocked puts + end-of-stream join
+        self._finished = False
+        self.threads = [
+            threading.Thread(target=self._loop, args=(s,),
+                             name=f"fold-shard-{s}", daemon=True)
+            for s in range(self.n)
+        ]
+        for t in self.threads:
+            t.start()
+
+    # ---- fold threads ----
+
+    def _loop(self, s: int) -> None:
+        shard = self.shards[s]
+        # Sanitizer registration (ISSUE 9 satellite): this thread becomes
+        # the shard dictionary's owner and a registered stats writer —
+        # no-ops unsanitized, asserts armed under MR_SANITIZE=1.
+        self.stats.register_writer()
+        set_owner = getattr(shard, "set_owner", None)
+        if set_owner is not None:
+            set_owner()
+        q = self.queues[s]
+        try:
+            while True:
+                t0 = time.perf_counter()
+                item = q.get()
+                self.idle_s[s] += time.perf_counter() - t0
+                if item is self._SENTINEL:
+                    return
+                if self.poisoned.is_set():
+                    continue  # another shard failed: drain, don't fold
+                t0 = time.perf_counter()
+                with trace_span("host_map.fold", shard=s):
+                    self._fold_one(s, shard, item)
+                dt = time.perf_counter() - t0
+                self.fold_s[s] += dt
+                self.hists[s].add(dt)
+        except BaseException as e:
+            self.errors[s] = e
+            self.poisoned.set()
+            # Keep consuming (discarding) until the sentinel: the router's
+            # bounded put must never deadlock against a dead fold thread.
+            while q.get() is not self._SENTINEL:
+                pass
+
+    def _fold_one(self, s: int, shard, item) -> None:
+        kind = item[0]
+        if kind == "raw":
+            # Pre-partitioned native scan: rows [lo, hi) and one
+            # contiguous word-bytes span belong to this shard.
+            _, raw, ends, keys, lo, hi, mask = item
+            if hi <= lo:
+                return
+            base = int(ends[lo - 1]) if lo else 0
+            raw_s = raw[base:int(ends[hi - 1])]
+            ends_s = ends[lo:hi] - base
+            keys_s = keys[lo:hi]
+            if self._sanitize:
+                from mapreduce_rust_tpu.analysis.sanitize import (
+                    check_shard_route,
+                )
+
+                check_shard_route(keys_s, self.n, s)
+            mask_s = mask[lo:hi] if mask is not None else None
+            fold_scan_into_dictionary(shard, mask_s, "raw",
+                                      (raw_s, ends_s, keys_s))
+        else:
+            # Python-fallback scan: no pre-partitioning, so every shard
+            # thread selects its own keys from the shared result — the
+            # per-word slicing cost parallelizes across shards exactly
+            # like the fold it feeds.
+            _, words, keys, mask = item
+            from mapreduce_rust_tpu.runtime.dictionary import (
+                shard_ids_of_packed,
+            )
+
+            packed = (
+                keys[:, 0].astype(np.uint64) << np.uint64(32)
+            ) | keys[:, 1].astype(np.uint64)
+            sel = shard_ids_of_packed(packed, self.n) == np.uint64(s)
+            if mask is not None:
+                sel &= mask
+            idx = np.nonzero(sel)[0].tolist()
+            if idx:
+                shard.add_scanned([words[i] for i in idx], keys[idx])
+
+    # ---- router side ----
+
+    def _raise_error(self) -> None:
+        for e in self.errors:
+            if e is not None:
+                raise e
+        raise RuntimeError("fold plane poisoned without a recorded error")
+
+    def _put(self, s: int, item) -> None:
+        import queue as _queue
+
+        if self.poisoned.is_set():
+            self._raise_error()
+        q = self.queues[s]
+        try:
+            q.put_nowait(item)
+            return
+        except _queue.Full:
+            pass
+        # Blocked = fold backpressure: timed separately from glue so the
+        # bottleneck attribution can say "the fold is the ceiling".
+        t0 = time.perf_counter()
+        try:
+            with trace_span("host_map.fold_stall", shard=s):
+                while True:
+                    if self.poisoned.is_set():
+                        self._raise_error()
+                    try:
+                        q.put(item, timeout=0.05)
+                        return
+                    except _queue.Full:
+                        continue
+        finally:
+            self.stall_s += time.perf_counter() - t0
+
+    def route_raw(self, raw, ends, keys, shard_counts, mask) -> None:
+        """Hand each shard its slice of one pre-partitioned scan result.
+        O(shards) router work per window — the per-word routing loop this
+        PR deletes lives in the native kernel now."""
+        cum = 0
+        for s, c in enumerate(shard_counts.tolist()):
+            lo, hi = cum, cum + c
+            cum = hi
+            if c:
+                self._put(s, ("raw", raw, ends, keys, lo, hi, mask))
+
+    def route_list(self, words, keys, mask) -> None:
+        for s in range(self.n):
+            self._put(s, ("list", words, keys, mask))
+
+    def finish(self) -> None:
+        """Clean end-of-stream: sentinel every queue, join every thread,
+        surface any fold error — called AFTER the last scan result was
+        routed, so the teardown order is router → fold threads → (the
+        caller's) device merge drain."""
+        if self._finished:
+            return
+        self._finished = True
+        t0 = time.perf_counter()
+        for q in self.queues:
+            q.put(self._SENTINEL)
+        for t in self.threads:
+            t.join()
+        self.stall_s += time.perf_counter() - t0
+        if self.poisoned.is_set():
+            self._raise_error()
+
+    def abort(self) -> None:
+        """Exception-path teardown: poison (fold threads discard their
+        backlog), force a sentinel past a full queue by displacing one
+        item, reap the threads. Idempotent, never raises, never blocks
+        forever."""
+        import queue as _queue
+
+        self.poisoned.set()
+        if self._finished:
+            return  # finish() already joined the threads
+        self._finished = True
+        for q in self.queues:
+            while True:
+                try:
+                    q.put_nowait(self._SENTINEL)
+                    break
+                except _queue.Full:
+                    try:
+                        q.get_nowait()
+                    except _queue.Empty:
+                        pass
+        for t in self.threads:
+            t.join(timeout=10)
+
+    def collect(self, stats: JobStats) -> None:
+        """Fold the per-thread tallies into JobStats — router thread only,
+        after finish/abort joined the threads, so no write races exist
+        (and the sanitizer's single-writer contract holds)."""
+        stats.fold_s = sum(self.fold_s)
+        stats.fold_stall_s = self.stall_s
+        stats.fold_shard_s = [round(v, 6) for v in self.fold_s]
+        stats.fold_shard_idle_s = [round(v, 6) for v in self.idle_s]
+        agg = stats.hists.get("host_map.fold_s")
+        if agg is None:
+            agg = stats.hists["host_map.fold_s"] = Histogram()
+        for h in self.hists:
+            if h.count:
+                agg.merge(h)
+
+
 _CUT_PROBE = 1 << 16  # how far back a window cut searches for whitespace
 
 
@@ -927,15 +1169,35 @@ def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
     buffers, never O(corpus). The scan workers are PURE functions of their
     window — all shared state (stats, dictionary, device stream) is
     touched only here, which is also what makes teardown safe: an orphaned
-    scan can finish into the void without racing the unwound stream."""
+    scan can finish into the void without racing the unwound stream.
+
+    The FOLD fans out too (ISSUE 9 tentpole): with a ShardedDictionary the
+    consumer becomes a ROUTER — the native scan returns each window
+    pre-partitioned by key-hash shard (one contiguous slice per shard),
+    the router hands shard s its slice over a bounded queue, and S fold
+    threads (each the sole owner of one shard dictionary) fold in window
+    order. The device merge stream is scattered back to EXACT scan order
+    first, so merges, evictions and therefore outputs and spill totals are
+    bit-identical for every (host_map_workers, fold_shards) combination —
+    the same contract the scan fan-out holds for worker counts."""
     from mapreduce_rust_tpu.native import host as native_host
-    from mapreduce_rust_tpu.native.host import scan_count_raw
+    from mapreduce_rust_tpu.native.host import (
+        scan_count_raw,
+        scan_count_sharded_raw,
+    )
 
     enable_compilation_cache(cfg.compilation_cache_dir)
     device = select_device(cfg.device)
     depth = max(cfg.pipeline_depth, 1)
     workers = cfg.effective_host_map_workers()
     stats.host_map_workers = workers
+    fold_n = (
+        dictionary.n_shards if isinstance(dictionary, ShardedDictionary) else 1
+    )
+    stats.fold_shards = fold_n
+    fold: "_FoldShardPlane | None" = None  # started right before the
+    # stream loop's try block — device setup below can raise, and fold
+    # threads started earlier would leak, blocked forever on q.get()
     state = jax.device_put(KVBatch.empty(cfg.merge_capacity), device)
     pending: collections.deque = collections.deque()  # (ev_count, evicted)
 
@@ -966,11 +1228,20 @@ def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
         doc_id, window = item
         t0 = time.perf_counter()
         with trace_span("host_map.scan", doc=doc_id, bytes=int(window.size)):
-            res = scan_count_raw(window)
-            out = (
-                (doc_id, "raw", res) if res is not None
-                else (doc_id, "py", _py_scan_count(window))
-            )
+            if fold is not None:
+                # Sharded fold: the native kernel pre-partitions the scan
+                # result by key-hash shard in the same fused pass.
+                res = scan_count_sharded_raw(window, fold.n)
+                out = (
+                    (doc_id, "raw_sharded", res) if res is not None
+                    else (doc_id, "py", _py_scan_count(window))
+                )
+            else:
+                res = scan_count_raw(window)
+                out = (
+                    (doc_id, "raw", res) if res is not None
+                    else (doc_id, "py", _py_scan_count(window))
+                )
         return (*out, time.perf_counter() - t0)
 
     def consume(result) -> None:
@@ -981,18 +1252,48 @@ def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
         # as a p99 tail here long before it moves the aggregate (ISSUE 5).
         stats.record_hist("host_map.scan_s", scan_s)
         t_glue = time.perf_counter()
+        stall0 = fold.stall_s if fold is not None else 0.0
         with trace_span("host_glue"):
             stats.chunks += 1
-            if kind == "raw":
+            if kind == "raw_sharded":
+                # Sharded fold (ISSUE 9): route each shard its
+                # pre-partitioned slice — O(shards) router work, the fold
+                # threads do the word-level folding — then scatter
+                # keys/counts back to EXACT scan order for the device
+                # merge. The update stream the device sees is identical to
+                # the unsharded engine's, so merge evictions (and with
+                # them spill totals and outputs) cannot depend on
+                # fold_shards.
+                raw, ends, keys, counts, pos, shard_counts = res
+                mask = app.host_mask(keys)  # grouped rows; per-row exact
+                fold.route_raw(raw, ends, keys, shard_counts, mask)
+                keys_d = np.empty_like(keys)
+                keys_d[pos] = keys
+                counts_d = np.empty_like(counts)
+                counts_d[pos] = counts
+                if mask is not None:  # filtering app: query keys only
+                    mask_d = np.empty(len(pos), dtype=bool)
+                    mask_d[pos] = mask
+                    keys_d, counts_d = keys_d[mask_d], counts_d[mask_d]
+                keys, counts = keys_d, counts_d
+            elif kind == "raw":
                 raw, ends, keys, counts = res
                 mask = app.host_mask(keys)
                 fold_scan_into_dictionary(dictionary, mask, "raw", (raw, ends, keys))
+                if mask is not None:  # filtering app: query keys only
+                    keys, counts = keys[mask], counts[mask]
             else:
                 words, keys, counts = res
                 mask = app.host_mask(keys)
-                fold_scan_into_dictionary(dictionary, mask, "list", (words, keys))
-            if mask is not None:  # filtering app (e.g. grep): query keys only
-                keys, counts = keys[mask], counts[mask]
+                if fold is not None:
+                    # Python-fallback scan has no pre-partitioning: the
+                    # whole (read-only) result fans out and each shard
+                    # thread selects its own keys.
+                    fold.route_list(words, keys, mask)
+                else:
+                    fold_scan_into_dictionary(dictionary, mask, "list", (words, keys))
+                if mask is not None:  # filtering app: query keys only
+                    keys, counts = keys[mask], counts[mask]
             values = app.host_values(counts, doc_id_offset + doc_id)
             # Fixed update capacity, splitting big windows across merges: ONE
             # compiled merge shape for the whole run (a variable cap means a
@@ -1007,10 +1308,25 @@ def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
                 state, evicted, ev_count = merge_packed(state, flat)
                 pending.append((ev_count, evicted))
         # Glue stops before drain: drain's blocking readback is already
-        # accounted in device_wait_s and must not be double-counted.
+        # accounted in device_wait_s and must not be double-counted. Time
+        # the router spent BLOCKED on full shard queues is fold
+        # backpressure (fold_stall_s), not glue — subtracted so glue keeps
+        # meaning "router's own work".
         glue_dt = time.perf_counter() - t_glue
+        if fold is not None:
+            glue_dt = max(glue_dt - (fold.stall_s - stall0), 0.0)
         stats.host_glue_s += glue_dt
         stats.record_hist("host_map.glue_s", glue_dt)
+        if fold is not None:
+            # Publish the running fold totals per window (router thread):
+            # the plane's tallies are plane-local until collect(), and the
+            # live ring / renewal-envelope / streaming-doctor series would
+            # otherwise read 0 for the whole run — a fold-bound job must
+            # name host-fold LIVE, not just post-mortem. Reading the fold
+            # threads' float cells is benign (slightly stale at worst);
+            # collect() writes the exact finals at teardown.
+            stats.fold_s = sum(fold.fold_s)
+            stats.fold_stall_s = fold.stall_s
         maybe_snapshot()  # flight-recorder tick: per window, consumer thread
         metrics_tick()    # live-metrics sampler, same piggyback contract
         if len(pending) >= 2 * depth:
@@ -1039,6 +1355,13 @@ def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
         return res
 
     pool = ThreadPoolExecutor(max_workers=workers, thread_name_prefix="host-map")
+    if fold_n > 1:
+        # Started HERE, not at function entry: everything that can raise
+        # during setup (device selection/state allocation, pool creation)
+        # is behind us, and the very next statement is the try whose
+        # except/finally owns the plane's teardown — no window where an
+        # exception strands S fold threads on q.get().
+        fold = _FoldShardPlane(cfg, stats, dictionary.shards)
     try:
         for item in _iter_windows(cfg, inputs, stats):
             inflight.append(pool.submit(scan_window, item))
@@ -1047,7 +1370,21 @@ def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
         while inflight:
             consume(next_result())
         stats.host_arena_bytes = native_host.arena_bytes()
+        if fold is not None:
+            # Teardown ORDER (ISSUE 9 satellite): the router is fully
+            # drained (every scan result routed above), THEN the fold
+            # threads flush and join, THEN the device merge drains below —
+            # each stage's producers are gone before it stops. A fold
+            # error recorded mid-stream surfaces here (or at the route
+            # that first observed the poison).
+            fold.finish()
+    except BaseException:
+        if fold is not None:
+            fold.abort()
+        raise
     finally:
+        if fold is not None:
+            fold.collect(stats)  # threads joined by finish()/abort()
         # cancel_futures + wait (the old wait=False shutdown abandoned an
         # in-flight scan on exception: the orphaned future kept its memmap
         # window alive past the stream's unwind — ISSUE 2 satellite).
@@ -1759,9 +2096,32 @@ def run_job(
         ),
         spill_dir=cfg.work_dir,
     )
-    dictionary = new_dictionary(
-        cfg, budget_words=cfg.dictionary_budget_words, spill_dir=cfg.work_dir
-    )
+    # Sharded egress fold (ISSUE 9): the single-process host-map engine
+    # splits the dictionary into S key-hash-disjoint shards, each owned by
+    # one fold thread of _FoldShardPlane. Every other engine keeps the
+    # single-dictionary fold (mesh tokenizes on device; multihost already
+    # merges per-PROCESS dictionary shards; checkpoint/resume persists the
+    # plain Dictionary). The word budget splits across shards so the
+    # bounded-memory contract is per-process, not per-shard×S.
+    fold_shards = 1
+    if (cfg.map_engine == "host"
+            and not (cfg.mesh_shape and cfg.mesh_shape > 1)
+            and jax.process_count() == 1):
+        fold_shards = cfg.effective_fold_shards()
+    if fold_shards > 1:
+        per_shard_budget = (
+            max(1, cfg.dictionary_budget_words // fold_shards)
+            if cfg.dictionary_budget_words is not None else None
+        )
+        dictionary = ShardedDictionary([
+            new_dictionary(cfg, budget_words=per_shard_budget,
+                           spill_dir=cfg.work_dir)
+            for _ in range(fold_shards)
+        ])
+    else:
+        dictionary = new_dictionary(
+            cfg, budget_words=cfg.dictionary_budget_words, spill_dir=cfg.work_dir
+        )
     # Compile instrumentation rides every run (cheap: two listeners, a
     # list append per compile); the slice below scopes the process-global
     # log to THIS run's interval.

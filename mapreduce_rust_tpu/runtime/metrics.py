@@ -90,6 +90,25 @@ class JobStats:
     # thread, so the split names which of the two to optimize
     host_map_workers: int = 0     # scan threads the host-map engine ran
                                   # (0 = engine not used this run)
+    # ---- sharded egress fold (ISSUE 9) ----
+    fold_shards: int = 0          # fold shards the host-map engine ran
+    # (0 = engine not used; 1 = legacy inline fold on the consumer thread;
+    # >1 = the sharded fold plane: S fold threads, each the sole owner of
+    # one key-hash-disjoint dictionary shard)
+    fold_s: float = 0.0           # seconds fold threads spent folding scan
+    # results into their shards — AGGREGATE across fold threads (like
+    # host_map_s across scan workers: with S>1 this may exceed wall time;
+    # per-shard balance lives in fold_shard_s)
+    fold_stall_s: float = 0.0     # router wall seconds blocked on fold
+    # backpressure: full shard queues plus the end-of-stream join. The
+    # wall-clock "the fold is the ceiling" signal, exactly as scan_wait_s
+    # is for the scans — large means more shards (or a flatter key hash)
+    # would raise throughput
+    fold_shard_s: list = dataclasses.field(default_factory=list)
+    # per-shard fold seconds (index = shard): the fold-balance signal the
+    # doctor's fold-shard-skew finding scores
+    fold_shard_idle_s: list = dataclasses.field(default_factory=list)
+    # per-shard seconds the fold thread sat waiting for routed work
     scan_wait_s: float = 0.0      # consumer wall time blocked waiting for
     # the next IN-ORDER scan result: the parallel engine's starvation
     # signal — large scan_wait means more workers (or a faster scan) would
@@ -161,6 +180,12 @@ class JobStats:
             "host-map": scan,
             "host-glue": self.host_glue_s,
         }
+        if self.fold_shards > 1:
+            # Sharded fold plane (ISSUE 9): folding runs off the consumer
+            # thread, so host_glue_s no longer contains it — the honest
+            # wall-clock "the fold is the ceiling" signal is the router's
+            # fold backpressure, same logic as scan_wait_s for the scans.
+            parts["host-fold"] = self.fold_stall_s
         name, val = max(parts.items(), key=lambda kv: kv[1])
         return name if val > 0 else "balanced"
 
@@ -193,7 +218,13 @@ class JobStats:
                 f"/{self.host_map_workers}w stall={self.scan_wait_s:.2f}s"
                 if self.host_map_workers > 1 else ""
             )
-            + f" glue={self.host_glue_s:.2f}s → {self.bottleneck}] [{phases}]"
+            + f" glue={self.host_glue_s:.2f}s"
+            + (
+                f" fold={self.fold_s:.2f}s/{self.fold_shards}sh "
+                f"fstall={self.fold_stall_s:.2f}s"
+                if self.fold_shards > 1 else ""
+            )
+            + f" → {self.bottleneck}] [{phases}]"
         )
 
 
@@ -562,6 +593,8 @@ def jobstats_collector(stats: JobStats):
             "job.device_wait_s": round(stats.device_wait_s, 6),
             "job.host_map_s": round(stats.host_map_s, 6),
             "job.host_glue_s": round(stats.host_glue_s, 6),
+            "job.fold_s": round(stats.fold_s, 6),
+            "job.fold_stall_s": round(stats.fold_stall_s, 6),
             "job.scan_wait_s": round(stats.scan_wait_s, 6),
             "job.all_to_all_s": round(stats.all_to_all_s, 6),
             "job.mesh_rounds": stats.mesh_rounds,

@@ -580,6 +580,28 @@ def stats_to_dict(stats) -> dict:
                 round(stats.host_map_s / stream_s, 3) if stream_s else None
             ),
         }
+    if stats.fold_shards > 1:
+        shard_s = [round(v, 6) for v in stats.fold_shard_s]
+        mean = (sum(shard_s) / len(shard_s)) if shard_s else 0.0
+        d["fold_split"] = {
+            "shards": stats.fold_shards,
+            # per_shard_s sums to fold_s by construction: the per-shard
+            # balance the doctor's fold-shard-skew finding scores.
+            "fold_s": round(stats.fold_s, 6),
+            "fold_stall_s": round(stats.fold_stall_s, 6),
+            "per_shard_s": shard_s,
+            "per_shard_idle_s": [round(v, 6) for v in stats.fold_shard_idle_s],
+            # 1.0 = perfectly balanced; 2.0 = the hottest shard folds twice
+            # its fair share (same convention as the doctor's skew scores).
+            "balance": (
+                round(max(shard_s) / mean, 3) if shard_s and mean else None
+            ),
+            # fold seconds overlapped per stream second — → S when the
+            # sharded fold scales perfectly (the host_map_split twin).
+            "fold_parallelism": (
+                round(stats.fold_s / stream_s, 3) if stream_s else None
+            ),
+        }
     if stats.mesh_rounds > 0:
         d["ici_split"] = {
             "rounds": stats.mesh_rounds,
@@ -767,6 +789,15 @@ def format_manifest(m: dict) -> str:
                 f"parallel), stall={hm['scan_stall_s']:.3f}s "
                 f"glue={hm['glue_s']:.3f}s device={hm['device_wait_s']:.3f}s "
                 f"arenas={hm['arena_bytes'] / 1e6:.0f} MB"
+            )
+        fs = s.get("fold_split")
+        if fs:
+            lines.append(
+                f"  fold split: {fs['shards']} shards, "
+                f"fold={fs['fold_s']:.3f}s "
+                f"(x{fs['fold_parallelism'] or 0:.2f} parallel, "
+                f"balance {fs['balance'] or 0:.2f}) "
+                f"stall={fs['fold_stall_s']:.3f}s"
             )
         ici = s.get("ici_split")
         if ici:

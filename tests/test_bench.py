@@ -65,3 +65,47 @@ def test_device_leg_fast_crash_reports_rc_not_wedge(tmp_path):
     assert dev is None
     assert "rc=" in err and "heartbeat" not in err, err
     assert dt < 30, f"crash took {dt:.1f}s — init deadline was not short-circuited"
+
+
+def test_sweep_fold_shards_curve_and_validation(tmp_path, monkeypatch, capsys):
+    """--sweep-fold-shards (ISSUE 9 satellite): one leg per shard count
+    with BENCH_FOLD_SHARDS + a per-count run-manifest path, one JSON curve
+    anchored to the FIRST count; bad specs are usage errors. The legs are
+    stubbed — the subprocess engine itself is covered by the contract test
+    above and tests/test_fold_shards.py."""
+    import pytest
+
+    sys.path.insert(0, str(REPO_ROOT))
+    import bench
+
+    corpus = tmp_path / "c.txt"
+    corpus.write_bytes(b"a b c\n" * 100)
+    monkeypatch.setattr(bench, "build_corpus", lambda mb: corpus)
+    seen = []
+
+    def fake_leg(c, timeout_s, env, init_timeout_s=None, mode="--device-leg"):
+        seen.append((env["BENCH_FOLD_SHARDS"], env["BENCH_RUN_MANIFEST"]))
+        n = int(env["BENCH_FOLD_SHARDS"])
+        return {
+            "gbs": 0.1 * n,
+            "stats": {
+                "bottleneck": "host-fold" if n > 1 else "host-glue",
+                "host_glue_s": 1.0 / n,
+                "fold_stall_s": 0.01,
+                "fold_split": {"fold_parallelism": float(n), "balance": 1.0},
+            },
+        }, None
+
+    monkeypatch.setattr(bench, "_run_device_leg", fake_leg)
+    bench.sweep_fold_shards("1,2,4")
+    out = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    doc = json.loads(out[-1])
+    assert [p["fold_shards"] for p in doc["sweep"]] == [1, 2, 4]
+    assert doc["speedup_vs_first"] == [1.0, 2.0, 4.0]
+    assert [s for s, _m in seen] == ["1", "2", "4"]
+    assert all("run-s" in m for _s, m in seen)
+    assert doc["sweep"][2]["bottleneck"] == "host-fold"
+    with pytest.raises(SystemExit):
+        bench.sweep_fold_shards("0,2")
+    with pytest.raises(SystemExit):
+        bench.sweep_fold_shards(" , ")

@@ -1,0 +1,326 @@
+"""Sharded parallel egress fold (ISSUE 9 tentpole): the fold fan-out must
+be invisible in the results — final counts, dictionary contents, spill
+totals and the output FILES bit-identical for every (host_map_workers,
+fold_shards) combination, including forced-cut windows, filtering apps and
+budgets small enough to spill every shard — while the manifest grows the
+fold_split (per-shard balance summing to totals), the doctor's bottleneck
+attribution learns the fold component, a fold-thread failure unwinds
+cleanly (poisoned router, no deadlocked put, no orphan arenas), and the
+whole fold path holds under MR_SANITIZE=1 with every fold thread a
+registered owner of exactly its shard."""
+
+import gc
+import json
+import pathlib
+
+import numpy as np
+import pytest
+
+from mapreduce_rust_tpu.apps import get_app
+from mapreduce_rust_tpu.config import Config
+from mapreduce_rust_tpu.runtime import telemetry
+from mapreduce_rust_tpu.runtime.dictionary import (
+    Dictionary,
+    ShardedDictionary,
+    shard_of_packed,
+)
+from mapreduce_rust_tpu.runtime.driver import run_job
+
+WORKER_COUNTS = [1, 2, 4]
+SHARD_COUNTS = [1, 2, 4]
+
+# Same corpus shape as tests/test_host_workers.py: ~40 windows at 4 KB,
+# multi-doc, one whitespace-free run longer than a window (forced cut) and
+# a high-cardinality tail driving device→host spills.
+TEXTS = [
+    ("the quick brown fox jumps over the lazy dog " * 600
+     + "x" * 6000 + " "
+     + "pack my box with five dozen liquor jugs " * 500),
+    ("zebra quagga okapi " * 2000
+     + " ".join(f"w{i:05d}" for i in range(3000))),
+]
+
+
+def write_inputs(tmp_path, texts):
+    paths = []
+    for i, t in enumerate(texts):
+        p = tmp_path / f"doc-{i}.txt"
+        p.write_bytes(t if isinstance(t, bytes) else t.encode())
+        paths.append(str(p))
+    return paths
+
+
+def cfg_for(tmp_path, tag: str, workers: int, shards: int, **kw) -> Config:
+    defaults = dict(
+        map_engine="host",
+        host_map_workers=workers,
+        fold_shards=shards,
+        host_window_bytes=4096,
+        host_update_cap=256,        # force multi-merge splits per window
+        merge_capacity=512,         # force device→host spills
+        reduce_n=4,
+        output_dir=str(tmp_path / f"out-{tag}-w{workers}s{shards}"),
+        work_dir=str(tmp_path / f"work-{tag}-w{workers}s{shards}"),
+        device="cpu",
+    )
+    defaults.update(kw)
+    return Config(**defaults)
+
+
+def output_bytes(res) -> list[bytes]:
+    return [pathlib.Path(p).read_bytes() for p in res.output_files]
+
+
+def test_full_matrix_bit_identical_word_count(tmp_path):
+    paths = write_inputs(tmp_path, TEXTS)
+    first = None
+    for w in WORKER_COUNTS:
+        for s in SHARD_COUNTS:
+            res = run_job(cfg_for(tmp_path, "wc", w, s), paths)
+            assert res.stats.host_map_workers == w
+            assert res.stats.fold_shards == s
+            assert res.stats.forced_cuts > 0   # the forced-cut window ran
+            assert res.stats.spill_events > 0  # the device spill path ran
+            if first is None:
+                first = res
+                continue
+            # Results, dictionary size, spill totals and the files
+            # themselves — the exact contract PR 2 held for scan workers,
+            # now over the (W, S) product.
+            assert res.table == first.table, (w, s)
+            assert res.stats.dictionary_words == first.stats.dictionary_words
+            assert res.stats.spilled_keys == first.stats.spilled_keys
+            assert res.stats.spill_events == first.stats.spill_events
+            assert res.stats.chunks == first.stats.chunks
+            assert output_bytes(res) == output_bytes(first), (w, s)
+
+
+def test_grep_and_topk_identical_across_shards(tmp_path):
+    paths = write_inputs(tmp_path, TEXTS)
+    combos = [(1, 1), (2, 4), (4, 2)]
+    greps = {}
+    for w, s in combos:
+        app = get_app("grep", query=("fox", "zebra", "missingword"))
+        greps[(w, s)] = run_job(
+            cfg_for(tmp_path, "grep", w, s, merge_capacity=1 << 14),
+            paths, app=app,
+        )
+    first = greps[combos[0]]
+    assert first.table == {b"fox": [0], b"zebra": [1]}
+    for key in combos[1:]:
+        assert greps[key].table == first.table
+        assert output_bytes(greps[key]) == output_bytes(first)
+        # The filter keeps each shard dictionary query-sized too.
+        assert greps[key].stats.dictionary_words == first.stats.dictionary_words
+    topks = {
+        (w, s): run_job(
+            cfg_for(tmp_path, "topk", w, s, merge_capacity=1 << 14),
+            paths, app=get_app("top_k", k=10),
+        )
+        for w, s in ((1, 1), (2, 4))
+    }
+    assert topks[(2, 4)].table == topks[(1, 1)].table
+    assert output_bytes(topks[(2, 4)]) == output_bytes(topks[(1, 1)])
+
+
+def test_spill_every_shard_streaming_egress_identical(tmp_path):
+    # Budget small enough that EVERY shard flushes dictionary runs, plus
+    # an accumulator budget engaging the streaming merge-join egress: the
+    # per-shard run interleave (ShardedDictionary.iter_sorted) must
+    # reproduce the unsharded sorted stream byte for byte.
+    paths = write_inputs(tmp_path, TEXTS)
+    runs = {}
+    for w, s in ((2, 1), (2, 2), (2, 4)):
+        res = run_job(
+            cfg_for(tmp_path, "spill", w, s,
+                    dictionary_budget_words=256, host_accum_budget_mb=1),
+            paths,
+        )
+        assert res.stats.dict_spill_runs >= s  # every shard spilled
+        assert res.table == {}                 # streaming egress: files only
+        runs[(w, s)] = res
+    base = output_bytes(runs[(2, 1)])
+    assert output_bytes(runs[(2, 2)]) == base
+    assert output_bytes(runs[(2, 4)]) == base
+
+
+def test_manifest_fold_split_and_doctor_attribution(tmp_path):
+    paths = write_inputs(tmp_path, TEXTS)
+    cfg = cfg_for(
+        tmp_path, "manifest", 2, 4,
+        manifest_path=str(tmp_path / "manifest.json"),
+        trace_path=str(tmp_path / "trace.json"),
+    )
+    res = run_job(cfg, paths, write_outputs=False)
+    m = telemetry.load_manifest(cfg.manifest_path)
+    split = m["stats"]["fold_split"]
+    assert split["shards"] == 4
+    assert len(split["per_shard_s"]) == 4
+    assert len(split["per_shard_idle_s"]) == 4
+    # Shard balance sums to totals (ISSUE 9 satellite).
+    assert sum(split["per_shard_s"]) == pytest.approx(split["fold_s"], abs=1e-3)
+    assert split["fold_s"] == pytest.approx(res.stats.fold_s, abs=1e-5)
+    assert split["fold_stall_s"] >= 0
+    assert m["stats"]["histograms"]["host_map.fold_s"]["count"] > 0
+    # The doctor's attribution mirrors JobStats.bottleneck exactly and
+    # carries the new fold component.
+    from mapreduce_rust_tpu.analysis.doctor import diagnose
+
+    diag = diagnose(m)
+    bn = diag["bottleneck"]
+    assert bn["agrees_with_stats"], bn
+    assert "host-fold" in {a["component"] for a in bn["attribution"]}
+    # Fold spans ride the trace per window/shard, never per record.
+    from mapreduce_rust_tpu.runtime.trace import validate_events
+
+    events = json.load(open(cfg.trace_path))["traceEvents"]
+    validate_events(events)
+    folds = [e for e in events if e["name"] == "host_map.fold"]
+    assert folds and all("shard" in e["args"] for e in folds)
+    n_records = sum(len(t.split()) for t in TEXTS)
+    assert len(events) < n_records / 10
+
+
+def test_doctor_fold_shard_skew_finding():
+    from mapreduce_rust_tpu.analysis.doctor import diagnose
+
+    manifest = {
+        "kind": "run_manifest",
+        "stats": {
+            "fold_shards": 4,
+            "fold_stall_s": 0.4,
+            "host_glue_s": 0.1,
+            "fold_split": {
+                "shards": 4,
+                "fold_s": 4.3,
+                "fold_stall_s": 0.4,
+                "per_shard_s": [4.0, 0.1, 0.1, 0.1],
+            },
+        },
+    }
+    diag = diagnose(manifest)
+    codes = {f["code"] for f in diag["findings"]}
+    assert "fold-shard-skew" in codes
+    assert diag["skew"]["fold_shard_s"]["score"] > 3
+    # Attribution names the fold when backpressure dominates the split.
+    assert diag["bottleneck"]["name"] == "host-fold"
+    # Balanced shards stay quiet.
+    manifest["stats"]["fold_split"]["per_shard_s"] = [1.1, 1.0, 1.1, 1.1]
+    diag = diagnose(manifest)
+    assert "fold-shard-skew" not in {f["code"] for f in diag["findings"]}
+
+
+def test_fold_thread_failure_poisons_router_and_unwinds(tmp_path, monkeypatch):
+    # Seeded failure (ISSUE 9 satellite): one fold thread dies mid-window;
+    # the router must surface the original error promptly (bounded queues,
+    # no deadlocked put), the job must unwind cleanly, and no scan arenas
+    # may leak past the teardown.
+    import mapreduce_rust_tpu.runtime.driver as drv
+    from mapreduce_rust_tpu.native import host as native_host
+
+    paths = write_inputs(tmp_path, TEXTS)
+    gc.collect()
+    baseline = native_host.arena_count()
+    calls = [0]
+    orig = drv._FoldShardPlane._fold_one
+
+    def boom(self, s, shard, item):
+        if s == 1:
+            calls[0] += 1
+            if calls[0] >= 2:
+                raise ValueError("seeded fold failure")
+        return orig(self, s, shard, item)
+
+    monkeypatch.setattr(drv._FoldShardPlane, "_fold_one", boom)
+    with pytest.raises(ValueError, match="seeded fold failure"):
+        run_job(cfg_for(tmp_path, "boom", 2, 4), paths)
+    # The crashed run's manifest path is irrelevant here; what matters is
+    # the teardown: scan pool reaped (wait=True) and fold threads joined,
+    # so the per-thread arenas die with their threads.
+    gc.collect()
+    assert native_host.arena_count() <= baseline
+
+
+def test_fold_path_exact_under_sanitizer(tmp_path, monkeypatch):
+    # ISSUE 9 satellite: the new fold path runs under MR_SANITIZE=1 in
+    # tier-1 — every fold thread registers as a stats writer and takes
+    # ownership of exactly its shard dictionary; results stay exact.
+    monkeypatch.setenv("MR_SANITIZE", "1")
+    paths = write_inputs(tmp_path, TEXTS)
+    plain = run_job(cfg_for(tmp_path, "san-ref", 1, 1), paths)
+    res = run_job(cfg_for(tmp_path, "san", 2, 4, sanitize=True), paths)
+    assert res.table == plain.table
+    assert output_bytes(res) == output_bytes(plain)
+    assert res.stats.fold_shards == 4
+
+
+def test_sanitizer_catches_wrong_shard_route():
+    from mapreduce_rust_tpu.analysis.sanitize import (
+        SanitizerError,
+        check_shard_route,
+    )
+
+    keys = np.array([[0, 0], [0, 1], [0, 2]], dtype=np.uint32)
+    shards = [shard_of_packed((int(k1) << 32) | int(k2), 4) for k1, k2 in keys]
+    # All keys routed to their true shard: silent.
+    for s in set(shards):
+        check_shard_route(keys[[i for i, x in enumerate(shards) if x == s]], 4, s)
+    # One key handed to the wrong shard's thread: raises, naming the key.
+    wrong = (shards[0] + 1) % 4
+    with pytest.raises(SanitizerError, match="routes to shard"):
+        check_shard_route(keys[:1], 4, wrong)
+
+
+def test_sharded_dictionary_reads_and_interleave(tmp_path):
+    shards = [Dictionary() for _ in range(4)]
+    sd = ShardedDictionary(shards)
+    words = [f"word{i}".encode() for i in range(200)]
+    from mapreduce_rust_tpu.core.hashing import hash_words
+
+    keys = hash_words(words)
+    for w, (k1, k2) in zip(words, keys.tolist()):
+        shards[sd.shard_of(k1, k2)].add_words([w])
+    assert len(sd) == len(words)
+    # iter_sorted is globally packed-key ordered and complete.
+    rows = list(sd.iter_sorted())
+    packed = [r[0] for r in rows]
+    assert packed == sorted(packed)
+    assert {r[3] for r in rows} == set(words)
+    # lookup routes to the owning shard.
+    for w, (k1, k2) in zip(words, keys.tolist()):
+        assert sd.lookup(k1, k2) == w
+    assert not sd.spilled and sd.run_count == 0
+    with pytest.raises(ValueError):
+        ShardedDictionary([])
+
+
+def test_mesh_engine_unaffected_by_fold_shards(tmp_path):
+    # fold_shards is a host-map-engine knob: a mesh run ignores it (the
+    # mesh IS the map engine) and its ICI split stays intact.
+    paths = write_inputs(tmp_path, [TEXTS[1]])
+    cfg = Config(
+        chunk_bytes=4096,
+        merge_capacity=1 << 12,
+        mesh_shape=4,
+        fold_shards=4,
+        reduce_n=4,
+        device="cpu",
+        output_dir=str(tmp_path / "out-mesh"),
+        work_dir=str(tmp_path / "work-mesh"),
+        manifest_path=str(tmp_path / "manifest-mesh.json"),
+    )
+    res = run_job(cfg, paths, write_outputs=False)
+    assert res.stats.fold_shards == 0        # fold plane never engaged
+    assert res.stats.mesh_rounds > 0
+    m = telemetry.load_manifest(cfg.manifest_path)
+    assert "ici_split" in m["stats"]
+    assert "fold_split" not in m["stats"]
+
+
+def test_fold_shards_config_validation():
+    assert Config(fold_shards=3).effective_fold_shards() == 3
+    assert Config().effective_fold_shards() >= 1
+    with pytest.raises(ValueError):
+        Config(fold_shards=0)
+    with pytest.raises(ValueError):
+        Config(fold_shards=-2)

@@ -1128,3 +1128,67 @@ def test_dataflow_resolve_prefers_same_class_then_is_conservative():
     """)
     go2 = next(fu for fu in prog2.functions if fu.name == "go")
     assert [t for _c, t in prog2.callees(go2)] == [None]
+
+
+# ---------------------------------------------------------------------------
+# Rule 12 cross-shard-fold (ISSUE 9): a function holding one shard index
+# must never fold into another shard's dictionary.
+# ---------------------------------------------------------------------------
+
+def test_cross_shard_fold_fires_on_foreign_constant_index(tmp_path):
+    fired, report = program_rules_fired(tmp_path, """
+        def fold(shard_idx, shards, raw, ends, keys):
+            shards[0].add_scanned_raw(raw, ends, keys)
+    """)
+    assert fired == ["cross-shard-fold"]
+    assert "shard_idx" in report.findings[0].message
+
+
+def test_cross_shard_fold_fires_through_alias(tmp_path):
+    # The dataflow layer's reaching-defs must see through the copy: the
+    # mutation receiver ALIASES a foreign-indexed shard subscript.
+    fired, _ = program_rules_fired(tmp_path, """
+        def fold(shard_idx, other, shards, words, keys):
+            d = shards[other]
+            d.add_scanned(words, keys)
+    """)
+    assert fired == ["cross-shard-fold"]
+
+
+def test_cross_shard_fold_fires_on_fold_helper_handoff(tmp_path):
+    # One-call-hop shape: a DIFFERENT shard's dictionary handed straight
+    # to a fold helper that will mutate it.
+    fired, _ = program_rules_fired(tmp_path, """
+        def route(shard_idx, victim, shards, mask, parts):
+            fold_scan_into_dictionary(shards[victim], mask, "raw", parts)
+    """)
+    assert fired == ["cross-shard-fold"]
+
+
+def test_cross_shard_fold_silent_on_own_shard_and_params(tmp_path):
+    # Own index (direct or aliased), index expressions that mention the
+    # shard param, and receivers arriving as plain parameters (the fold
+    # plane's _fold_one shape) all stay silent.
+    fired, _ = program_rules_fired(tmp_path, """
+        def fold(shard_idx, shards, raw, ends, keys, words, keys2):
+            shards[shard_idx].add_scanned_raw(raw, ends, keys)
+            d = shards[shard_idx]
+            d.add_scanned(words, keys2)
+
+        def route(shard_idx, shards, mask, parts):
+            fold_scan_into_dictionary(shards[shard_idx], mask, "raw", parts)
+
+        def fold_one(s, shard, words, keys):
+            shard.add_scanned(words, keys)
+    """)
+    assert fired == []
+
+
+def test_cross_shard_fold_silent_without_shard_param(tmp_path):
+    # No shard-index parameter in scope: nothing to contradict (the
+    # router legitimately touches every shard's queue).
+    fired, _ = program_rules_fired(tmp_path, """
+        def egress(shards, k1, k2):
+            return shards[(k1 << 32 | k2) % len(shards)].lookup(k1, k2)
+    """)
+    assert fired == []
