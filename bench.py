@@ -148,6 +148,9 @@ def _zipf_cfg(work: str, out: str, reduce_n: int):
     cannot silently diverge between word_count and inverted_index."""
     from mapreduce_rust_tpu.config import Config
 
+    # --sweep-spill-budget rides into the leg as BENCH_SPILL_BUDGET_WORDS
+    # (smaller budget = more, smaller runs = more spill-plane pressure).
+    budget = int(os.environ.get("BENCH_SPILL_BUDGET_WORDS") or (1 << 19))
     return Config(
         map_engine=os.environ.get("BENCH_MAP_ENGINE", "host"),
         host_map_workers=_env_host_workers(),
@@ -156,11 +159,15 @@ def _zipf_cfg(work: str, out: str, reduce_n: int):
         chunk_bytes=1 << 20,
         merge_capacity=1 << 18,        # << the Zipf vocab: constant eviction
         host_accum_budget_mb=256,      # spill-run tier engaged
-        dictionary_budget_words=1 << 19,  # dictionary tier engaged
+        dictionary_budget_words=budget,  # dictionary tier engaged
         reduce_n=reduce_n,
         work_dir=str(BENCH_DIR / work),
         output_dir=str(BENCH_DIR / out),
         device="auto",
+        # A per-leg run manifest (full JobStats incl. spill_split) when the
+        # sweep asks for one; distinct env var from the device leg's so the
+        # zipf leg can never clobber the measured leg's manifest.
+        manifest_path=os.environ.get("BENCH_ZIPF_RUN_MANIFEST") or None,
     )
 
 
@@ -268,6 +275,8 @@ def zipf_leg(target_mb: int) -> None:
                 got[int(w[1:], 16)] = int(v)
                 n_lines += 1
     exact = bool(np.array_equal(got, truth))
+    from mapreduce_rust_tpu.runtime.spill import RUN_FORMAT
+
     print(json.dumps({
         "zipf": {
             "bytes": s.bytes_in, "wall_s": round(dt, 3),
@@ -278,6 +287,17 @@ def zipf_leg(target_mb: int) -> None:
             "replays": s.partial_overflow_replays,
             "dict_words": s.dictionary_words,
             "map_engine": cfg.map_engine,
+            # Spill-plane attribution (ISSUE 11): the before/after story of
+            # the binary async plane lives in THESE fields' history rows.
+            "spill_format": RUN_FORMAT,
+            "spill_write_s": round(s.spill_s, 3),
+            "spill_stall_s": round(s.spill_stall_s, 3),
+            "spill_bytes": s.spill_bytes,
+            "dict_runs": s.dict_spill_runs,
+            "accum_runs": s.accum_spill_runs,
+            "merge_fanin": s.merge_fanin,
+            "budget_words": cfg.dictionary_budget_words,
+            "bottleneck": s.bottleneck,
         }
     }))
     if not exact:
@@ -788,6 +808,11 @@ def _run_device_leg(corpus: pathlib.Path, timeout_s: int, env: dict | None,
         run_manifest = child_env.setdefault(
             "BENCH_RUN_MANIFEST", str(BENCH_DIR / "leg-run-manifest.json")
         )
+    elif mode in ("--zipf", "--zipf-ii"):
+        # The zipf legs write a manifest only when asked (the spill-budget
+        # sweep) — a DIFFERENT env var, so they can never clobber the
+        # measured device leg's manifest in the same bench run.
+        run_manifest = child_env.get("BENCH_ZIPF_RUN_MANIFEST")
     t_start = time.time()
     proc = subprocess.Popen(
         [sys.executable, str(REPO / "bench.py"), mode, str(corpus)],
@@ -923,36 +948,47 @@ def _parse_sweep_counts(spec: str, flag: str) -> list:
 
 
 def _run_sweep(counts: list, env_var: str, file_prefix: str, point_key: str,
-               metric_label: str, manifest_cfg_key: str, point_stats) -> None:
-    """THE sweep harness (host-worker and fold-shard sweeps share it —
-    one copy, so the anchoring policy / manifest schema cannot drift):
-    one measured device leg per count with `env_var` riding into the
+               metric_label: str, manifest_cfg_key: str, point_stats,
+               mode: str = "--device-leg", corpus=None,
+               manifest_env: str = "BENCH_RUN_MANIFEST",
+               gbs_of=None, timeout_s: "int | None" = None,
+               corpus_label: "str | None" = None) -> None:
+    """THE sweep harness (host-worker, fold-shard and spill-budget sweeps
+    share it — one copy, so the anchoring policy / manifest schema cannot
+    drift): one measured leg per count with `env_var` riding into the
     subprocess, each leg writing its own run manifest under .bench/sweep/
     (run-{prefix}{n}.json), so scaling curves come from structured files,
     not scraped logs. Prints ONE JSON line: the curve with per-point GB/s
     plus whatever `point_stats(stats_dict)` extracts, and the manifest
     path to diff (`python -m mapreduce_rust_tpu stats run-w1.json
-    run-w4.json`)."""
-    corpus = build_corpus(TARGET_MB)
+    run-w4.json`). Non-default `mode` legs (the zipf spill sweep) plug in
+    their own corpus argument, manifest env var and GB/s extractor."""
+    if corpus is None:
+        corpus = build_corpus(TARGET_MB)
+    if gbs_of is None:
+        gbs_of = lambda res: res.get("gbs")  # noqa: E731
     sweep_dir = BENCH_DIR / "sweep"
     sweep_dir.mkdir(parents=True, exist_ok=True)
     curve = []
     for n in counts:
         env = dict(os.environ)
         env[env_var] = str(n)
-        env["BENCH_RUN_MANIFEST"] = str(sweep_dir / f"run-{file_prefix}{n}.json")
+        env[manifest_env] = str(sweep_dir / f"run-{file_prefix}{n}.json")
         if env.get("BENCH_TRACE"):
             # Per-leg trace files: one shared --trace path would be
             # rewritten by every leg and end up holding only the last.
             env["BENCH_TRACE"] = str(sweep_dir / f"trace-{file_prefix}{n}.json")
         res, err = _run_device_leg(
-            corpus, DEVICE_TIMEOUT_S, env, init_timeout_s=PROBE_TIMEOUT_S
+            corpus, timeout_s or DEVICE_TIMEOUT_S, env,
+            init_timeout_s=PROBE_TIMEOUT_S, mode=mode,
         )
-        point: dict = {point_key: n, "manifest": env["BENCH_RUN_MANIFEST"]}
+        point: dict = {point_key: n, "manifest": env[manifest_env]}
         if res is None:
             point["error"] = err
         else:
-            point["gbs"] = round(res["gbs"], 4)
+            gbs = gbs_of(res)
+            if gbs is not None:
+                point["gbs"] = round(gbs, 4)
             point.update(point_stats(res.get("stats") or {}))
         curve.append(point)
         print(f"sweep {file_prefix}={n}: {json.dumps(point)}", file=sys.stderr)
@@ -962,7 +998,8 @@ def _run_sweep(counts: list, env_var: str, file_prefix: str, point_key: str,
     base = curve[0].get("gbs")
     result = {
         "metric": f"word_count GB/s vs {metric_label} "
-                  f"({TARGET_MB}MB corpus, counts {counts})",
+                  f"({corpus_label or f'{TARGET_MB}MB corpus'}, "
+                  f"counts {counts})",
         "unit": "GB/s",
         "sweep": curve,
         "speedup_vs_first": [
@@ -1025,6 +1062,136 @@ def sweep_fold_shards(spec: str) -> None:
         "BENCH_FOLD_SHARDS", "s", "fold_shards", "fold shards",
         "sweep_fold_shards", point_stats,
     )
+
+
+def sweep_spill_budget(spec: str) -> None:
+    """`--sweep-spill-budget 131072,262144,524288` (ISSUE 11 satellite):
+    the spill-plane pressure curve — the ZIPF leg (budgets engaged,
+    exactness vs generator ground truth) once per dictionary budget, the
+    budget riding in as BENCH_SPILL_BUDGET_WORDS. Smaller budget = more,
+    smaller runs = more writer handoffs and a wider egress fan-in; the
+    per-point spill_split says whether the async writer still hides the
+    disk (stall_s ~ 0) or the budget is past the knee (spill-bound)."""
+    zipf_mb = int(os.environ.get("BENCH_ZIPF_MB", "256"))
+
+    def point_stats(s: dict) -> dict:
+        split = s.get("spill_split") or {}
+        return {
+            "bottleneck": s.get("bottleneck"),
+            "wall_s": s.get("wall_seconds"),
+            "spill_write_s": split.get("write_s"),
+            "spill_stall_s": split.get("stall_s"),
+            "dict_runs": split.get("dict_runs"),
+            "merge_fanin": split.get("merge_fanin"),
+        }
+
+    _run_sweep(
+        _parse_sweep_counts(spec, "--sweep-spill-budget"),
+        "BENCH_SPILL_BUDGET_WORDS", "b", "budget_words",
+        "dictionary spill budget (zipf leg)", "sweep_spill_budget",
+        point_stats, mode="--zipf",
+        corpus=pathlib.Path(str(zipf_mb)),
+        manifest_env="BENCH_ZIPF_RUN_MANIFEST",
+        gbs_of=lambda res: (res.get("zipf") or {}).get("gbs"),
+        timeout_s=int(os.environ.get("BENCH_ZIPF_TIMEOUT_S", "420")),
+        corpus_label=f"{zipf_mb}MB zipf corpus",
+    )
+
+
+def slow_disk_leg(path: str) -> None:
+    """Runs in a subprocess (--slow-disk-leg): the ISSUE 11 chaos pair —
+    the SAME budgeted word-count job under a seeded per-spill-run write
+    delay (`slow_disk`), async writer vs the legacy sync plane. The async
+    side overlaps the delayed writes with scan/merge compute (stall only
+    when the depth-2 buffer fills); the sync side eats every delay on the
+    fold thread's wall. Outputs must stay bit-identical — the overlap is
+    a scheduling change, never a data change."""
+    import jax
+
+    platform = jax.devices()[0].platform
+    print(f"BENCH_DEVICE_READY {platform}", file=sys.stderr, flush=True)
+
+    import dataclasses
+    import shutil
+
+    from mapreduce_rust_tpu.config import Config
+    from mapreduce_rust_tpu.runtime.driver import (
+        enable_compilation_cache,
+        run_job,
+    )
+    from mapreduce_rust_tpu.runtime.spill import chaos_fired
+
+    enable_compilation_cache("auto")
+    spec = os.environ.get("BENCH_SLOW_DISK_SPEC", "seed=6;slow_disk:0.25")
+    root = BENCH_DIR / "slow-disk"
+    base = Config(
+        map_engine="host",
+        # Small windows: a batch flush fires at most once per window, so
+        # window count bounds run count — ~24 windows over the 24 MB gut
+        # corpus keeps a steady stream of delayed writes to hide.
+        host_window_bytes=1 << 20,
+        chunk_bytes=1 << 20,
+        merge_capacity=1 << 14,          # constant device eviction = compute
+        dictionary_budget_words=1024,    # every new-vocab window flushes
+        host_accum_budget_mb=64,
+        reduce_n=4,
+        device="auto",
+        work_dir=str(root / "work"),
+        output_dir=str(root / "out"),
+    )
+    # Chaos-free warmup compiles every step shape so neither measured side
+    # pays XLA time (the persistent cache makes this cheap when warm).
+    shutil.rmtree(root, ignore_errors=True)
+    warm = BENCH_DIR / "warmup-slowdisk.txt"
+    with open(path, "rb") as f:
+        warm.write_bytes(f.read(base.host_window_bytes + 4096))
+    run_job(dataclasses.replace(
+        base, work_dir=str(root / "warm-work"),
+        output_dir=str(root / "warm-out"),
+        # Budgets off: warmup exists for the XLA compiles only, and a
+        # budgeted run demands write_outputs (streaming egress).
+        dictionary_budget_words=None, host_accum_budget_mb=None,
+    ), [str(warm)], write_outputs=False)
+
+    os.environ["MR_CHAOS"] = spec
+    sides: dict = {}
+    outputs: dict = {}
+    for side, async_spill in (("async", True), ("sync", False)):
+        cfg = dataclasses.replace(
+            base, spill_async=async_spill,
+            work_dir=str(root / f"work-{side}"),
+            output_dir=str(root / f"out-{side}"),
+        )
+        t0 = time.perf_counter()
+        res = run_job(cfg, [str(path)])
+        wall = time.perf_counter() - t0
+        s = res.stats
+        sides[side] = {
+            "wall_s": round(wall, 3),
+            "spill_write_s": round(s.spill_s, 3),
+            "spill_stall_s": round(s.spill_stall_s, 3),
+            "runs": s.dict_spill_runs + s.accum_spill_runs,
+        }
+        outputs[side] = {
+            p.name: p.read_bytes()
+            for p in sorted(pathlib.Path(cfg.output_dir).glob("mr-*.txt"))
+        }
+    fired = len(chaos_fired(spec))
+    identical = bool(outputs["async"]) and outputs["async"] == outputs["sync"]
+    hidden = round(sides["sync"]["wall_s"] - sides["async"]["wall_s"], 3)
+    print(json.dumps({
+        "slow_disk": {
+            "platform": platform,
+            "spec": spec,
+            "fired": fired,
+            "async": sides["async"],
+            "sync": sides["sync"],
+            "hidden_s": hidden,
+            "outputs_identical": identical,
+        }
+    }))
+    if not identical or fired == 0:
+        raise SystemExit(3)
 
 
 def _free_port() -> int:
@@ -1215,6 +1382,41 @@ def chaos_legs() -> None:
             "chaos_speculate": speculate,
             "chaos_mrcheck": r["mrcheck"],
         })
+    # Slow-disk pair (ISSUE 11 satellite): the seeded per-spill write
+    # delay against a BUDGETED driver job, async writer vs the sync
+    # plane — the matrix's cluster legs run unbudgeted, so the proof that
+    # the async writer HIDES the delay needs its own leg. Exit 3 in the
+    # leg = outputs diverged or the fault never fired; either fails here.
+    slow_disk = None
+    try:
+        sd_corpus = build_corpus(min(TARGET_MB, 24))
+        sd_res, sd_err = _run_device_leg(
+            sd_corpus, int(os.environ.get("BENCH_SLOW_DISK_TIMEOUT_S", "300")),
+            _cpu_env(), init_timeout_s=PROBE_TIMEOUT_S, mode="--slow-disk-leg",
+        )
+        if sd_res is None:
+            ok = False
+            slow_disk = {"error": sd_err}
+        else:
+            slow_disk = sd_res.get("slow_disk")
+            hidden = (slow_disk or {}).get("hidden_s")
+            if not (slow_disk or {}).get("outputs_identical") \
+                    or hidden is None or hidden <= 0:
+                ok = False  # the async writer must measurably hide the
+                # injected delay the sync plane eats on its wall
+        print(f"chaos slow_disk pair: {json.dumps(slow_disk)}",
+              file=sys.stderr)
+        _append_history({
+            "metric": "chaos slow_disk: async-vs-sync spill pair",
+            "value": None,  # chaos rows stay out of the trend series
+            "unit": "s",
+            "platform": "cpu",
+            "chaos_scenario": "slow_disk-pair",
+            "chaos_slow_disk": slow_disk,
+        })
+    except Exception as e:
+        ok = False
+        slow_disk = {"error": repr(e)}
     nospec = next((r for r in rows if r["scenario"] == "slow_scan-nospec"), None)
     spec = next((r for r in rows if r["scenario"] == "slow_scan-spec"), None)
     result = {
@@ -1224,6 +1426,7 @@ def chaos_legs() -> None:
         "ok": ok,
         "baseline_wall_s": baseline_wall,
         "scenarios": rows,
+        "slow_disk_pair": slow_disk,
         "speculation_speedup": (
             round(nospec["wall_s"] / spec["wall_s"], 2)
             if nospec and spec and nospec.get("wall_s") and spec.get("wall_s")
@@ -1467,6 +1670,13 @@ def _append_history(result: dict) -> None:
             "doctor_bottleneck": (result.get("doctor") or {}).get("bottleneck"),
             "fold_shards": result.get("fold_shards"),
             "zipf_gbs": (result.get("zipf") or {}).get("gbs"),
+            # Spill-plane before/after evidence (ISSUE 11): wall + stall
+            # per row, and the run format so the trajectory names which
+            # plane (text vs binary-v1) produced each number.
+            "zipf_wall_s": (result.get("zipf") or {}).get("wall_s"),
+            "zipf_spill_stall_s": (result.get("zipf") or {}).get("spill_stall_s"),
+            "zipf_spill_write_s": (result.get("zipf") or {}).get("spill_write_s"),
+            "spill_run_format": (result.get("zipf") or {}).get("spill_format"),
             # Sampler tax (ISSUE 8): a watched trend series (bad
             # direction: up) — None on chaos/sweep rows keeps it clean.
             "metrics_overhead_frac": (
@@ -1631,9 +1841,15 @@ if __name__ == "__main__":
                 f"--fold-shards needs a positive integer, got {_fold!r}"
             )
         os.environ["BENCH_FOLD_SHARDS"] = _fold
+    if _take_switch(_argv, "--sync-spill"):
+        # Legacy synchronous spill plane on every leg (A-B measurement):
+        # the env var rides into both inherited and cpu_only_env child
+        # environments like MR_SANITIZE.
+        os.environ["MR_SPILL_SYNC"] = "1"
     _chaos = _take_switch(_argv, "--chaos")
     _sweep = _take_flag(_argv, "--sweep-host-workers")
     _sweep_fold = _take_flag(_argv, "--sweep-fold-shards")
+    _sweep_spill = _take_flag(_argv, "--sweep-spill-budget")
     sys.argv = [sys.argv[0]] + _argv
     if _chaos:
         try:
@@ -1667,6 +1883,16 @@ if __name__ == "__main__":
                 "error": f"sweep harness: {e!r}",
             }))
             raise SystemExit(1)
+    elif _sweep_spill:
+        try:
+            sweep_spill_budget(_sweep_spill)
+        except BaseException as e:  # one JSON line, like the main harness
+            print(json.dumps({
+                "metric": "zipf GB/s vs dictionary spill budget",
+                "unit": "GB/s", "sweep": None,
+                "error": f"sweep harness: {e!r}",
+            }))
+            raise SystemExit(1)
     elif len(sys.argv) > 1 and sys.argv[1] == "--device-leg":
         device_leg(sys.argv[2])
     elif len(sys.argv) > 1 and sys.argv[1] == "--micro":
@@ -1677,6 +1903,8 @@ if __name__ == "__main__":
         zipf_leg(int(sys.argv[2]))
     elif len(sys.argv) > 1 and sys.argv[1] == "--zipf-ii":
         zipf_ii_leg(int(sys.argv[2]))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--slow-disk-leg":
+        slow_disk_leg(sys.argv[2])
     else:
         try:
             main()

@@ -133,6 +133,7 @@ def _cfg(args, map_n: int = 1, worker_n: int = 1) -> Config:
         mesh_shape=getattr(args, "mesh", None),
         host_accum_budget_mb=getattr(args, "accum_budget_mb", None),
         dictionary_budget_words=getattr(args, "dict_budget_words", None),
+        spill_async=not getattr(args, "sync_spill", False),
         profile_dir=args.profile_dir,
         trace_path=getattr(args, "trace", None),
         manifest_path=getattr(args, "manifest", None),
@@ -524,6 +525,11 @@ def main(argv: list[str] | None = None) -> int:
                    dest="dict_budget_words",
                    help="egress-dictionary RAM budget (words); above it, "
                         "sorted runs go to --work and finalize streams")
+    p.add_argument("--sync-spill", action="store_true", dest="sync_spill",
+                   help="write spill runs inline on the fold/consumer "
+                        "thread instead of the async background writer "
+                        "(debugging / A-B measurement; outputs identical; "
+                        "MR_SPILL_SYNC=1 does the same for a process tree)")
     p.add_argument("--distributed", action="store_true",
                    help="join a multi-host jax.distributed cluster before "
                    "building the mesh; the all_to_all shuffle then rides "

@@ -35,6 +35,15 @@ an int or ``*``; SECONDS a float)::
     slow_scan:wWID:SECONDS       worker WID computes SECONDS slower per
                                  task (the heterogeneous-fleet straggler
                                  the doctor flags and speculation beats)
+    slow_disk:SECONDS            every spill-run write sleeps SECONDS
+                                 first (runtime/spill.py — one checkpoint
+                                 covers dictionary AND accumulator tiers;
+                                 ``p=`` samples runs by seeded hash of the
+                                 run index). The slow-disk straggler the
+                                 ASYNC spill writer hides behind compute
+                                 while the sync plane stalls per run —
+                                 bench.py --chaos measures exactly that
+                                 pair (ISSUE 11)
 
 Trailing ``KEY=VAL`` args refine any fault: ``attempt=N`` (default 1 —
 a fault that re-fired on the recovery attempt would loop forever; ``*``
@@ -53,9 +62,9 @@ import os
 
 SITES = (
     "pause", "kill", "drop_finish", "delay_finish", "wedge_renewal",
-    "slow_scan",
+    "slow_scan", "slow_disk",
 )
-_NEEDS_SECONDS = ("pause", "delay_finish", "slow_scan")
+_NEEDS_SECONDS = ("pause", "delay_finish", "slow_scan", "slow_disk")
 
 #: Canonical scenario specs shared by ``bench.py --chaos`` and the chaos
 #: test suite — one copy, so the benched and the tested faults are the
@@ -70,6 +79,11 @@ SCENARIOS: dict[str, str] = {
     "drop_finish": "seed=3;drop_finish:reduce:0",
     "wedge_renewal": "seed=4;wedge_renewal:map:0;pause:map:0:3.0",
     "slow_scan": "seed=5;slow_scan:w0:2.5",
+    # Fires only where a spill tier engages (the cluster legs run
+    # unbudgeted, so there it is a fault-free control); the bench's
+    # dedicated --chaos slow-disk pair runs it against a BUDGETED job,
+    # async vs sync, to measure what the background writer hides.
+    "slow_disk": "seed=6;slow_disk:0.05",
 }
 
 
@@ -169,6 +183,11 @@ class ChaosPlan:
                 f.wid = int(pos[0][1:])
                 f.seconds = float(pos[1])
                 f.attempt = None  # a slow worker is slow on EVERY attempt
+            elif site == "slow_disk":
+                if len(pos) != 1:
+                    raise bad("slow_disk needs SECONDS")
+                f.seconds = float(pos[0])
+                f.attempt = None  # a slow disk is slow on EVERY run write
             else:
                 want = 3 if site in _NEEDS_SECONDS else 2
                 if len(pos) != want:

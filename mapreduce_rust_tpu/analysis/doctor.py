@@ -56,6 +56,7 @@ WATCHED_METRICS: dict = {
     "stats.device_wait_s": ("up", 0.50),
     "stats.host_glue_s": ("up", 0.50),
     "stats.fold_stall_s": ("up", 0.50),
+    "stats.spill_stall_s": ("up", 0.50),
     "stats.scan_wait_s": ("up", 0.50),
     "stats.all_to_all_s": ("up", 0.50),
     "stats.compile.total_s": ("up", 1.00),
@@ -65,6 +66,7 @@ WATCHED_METRICS: dict = {
     "stats.histograms.host_map.scan_s.p95": ("up", 0.50),
     "stats.histograms.host_map.glue_s.p95": ("up", 0.50),
     "stats.histograms.host_map.fold_s.p95": ("up", 0.50),
+    "stats.histograms.spill.write_s.p95": ("up", 0.50),
     "stats.histograms.a2a.round_s.p95": ("up", 0.50),
     "stats.histograms.device.drain_s.p95": ("up", 0.50),
 }
@@ -157,6 +159,13 @@ def _bottleneck_attribution(stats: dict) -> dict:
         "fold_shards" not in stats and (stats.get("fold_stall_s") or 0) > 0
     ):
         legacy["host-fold"] = stats.get("fold_stall_s", 0.0) or 0.0
+    # Async spill plane (ISSUE 11): writes run off the hot threads, so the
+    # disk component reads as owner-side writer backpressure — mirrors
+    # JobStats.bottleneck's arm exactly. Live fleet aggregates carry the
+    # fields only when a worker actually spilled, which is the same
+    # engagement test.
+    if (stats.get("spill_s") or 0) > 0 or (stats.get("spill_stall_s") or 0) > 0:
+        legacy["spill"] = stats.get("spill_stall_s", 0.0) or 0.0
     name, val = max(legacy.items(), key=lambda kv: kv[1])
     primary = name if val > 0 else "balanced"
     extended = dict(legacy)
@@ -248,6 +257,18 @@ def diagnose(manifest: dict, job_report: "dict | None" = None,
                  + ("a persistent compilation cache or longer run amortizes it"
                     if top["component"] == "compile"
                     else "fewer/fatter all_to_all rounds would"))
+        if bn["name"] == "spill":
+            sp = stats.get("spill_split") or {}
+            find("warn", "spill-bound",
+                 f"spill-writer backpressure ({stats.get('spill_stall_s', 0):.3f}s "
+                 "blocked on full writer queues) exceeds every other wait "
+                 "component — the disk tier is the ceiling: raise "
+                 "dictionary_budget_words / host_accum_budget_mb (fewer, "
+                 "larger runs), add fold_shards (one spill writer per "
+                 "shard), or move work_dir to faster storage"
+                 + (f" [{sp.get('bytes', 0) / 1e6:.0f} MB over "
+                    f"{sp.get('dict_runs', 0)}+{sp.get('accum_runs', 0)} "
+                    "runs]" if sp else ""))
         wall = stats.get("wall_seconds") or 0.0
         comp = stats.get("compile") or {}
         if comp and wall and comp.get("total_s", 0.0) > 0.5 * wall:
@@ -500,8 +521,8 @@ _POST_MORTEM_CODES = frozenset({
 #: _bottleneck_attribution understands (worker series are prefixed;
 #: strip to the JobStats field name).
 _WAIT_FIELDS = ("ingest_wait_s", "device_wait_s", "host_map_s",
-                "host_glue_s", "fold_s", "fold_stall_s", "scan_wait_s",
-                "all_to_all_s")
+                "host_glue_s", "fold_s", "fold_stall_s", "spill_s",
+                "spill_stall_s", "scan_wait_s", "all_to_all_s")
 
 
 def diagnose_live(stats_rpc: dict, lease_timeout_s: "float | None" = None,

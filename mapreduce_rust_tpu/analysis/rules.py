@@ -1288,6 +1288,126 @@ class CrossShardFoldRule(ProgramRule):
                         break
 
 
+class BlockingIoInFoldRule(ProgramRule):
+    """No file I/O reachable from the fold/consumer hot scopes (rule 13).
+
+    The binary async spill plane (ISSUE 11) exists because
+    ``Dictionary._flush_words`` used to sort and WRITE the run file
+    inline on the fold/consumer thread — a 15x throughput collapse on the
+    spill-engaged Zipf leg that three PRs of telemetry had to find. The
+    invariant this rule pins: the fold-side hot scopes (the fold-plane
+    thread body, the host-map consumer, the dictionary/accumulator fold
+    mutators) hand frozen snapshots to the async writer
+    (``AsyncSpillWriter.submit`` — an executor sink, so the handed task
+    is invisible to the call graph by design) and never ``open``/
+    ``.write``/``.flush``/``np.save`` a file themselves, directly or
+    through sync helper frames. Throttled telemetry ticks
+    (``maybe_snapshot``/``metrics_tick`` — the flight recorder and the
+    metrics sampler own their budgets) are the sanctioned exceptions.
+    """
+
+    name = "blocking-io-in-fold"
+    summary = "fold/consumer hot scopes do file I/O only via the async writer"
+
+    #: The fold/consumer hot scopes, by the runtime's naming: the fold
+    #: plane's per-shard body, the host-map consumer, and every
+    #: dictionary/accumulator fold mutator the stream loops call per
+    #: window. A rename there must update this list (the fixtures gate it).
+    _HOT = (
+        "_fold_one", "consume", "fold_scan_into_dictionary",
+        "add_scanned_raw", "add_scanned", "add_words", "_insert_hashed",
+        "_maybe_flush", "_flush_words", "add_batch", "_flush_run",
+    )
+    #: Direct file-I/O producers (builtin/module function calls).
+    _IO_FUNCS = {
+        "open": ("", "io", "os", "gzip", "bz2", "lzma"),
+        "save": ("np", "numpy"),
+        "savez": ("np", "numpy"),
+        "replace": ("os",),
+        "rename": ("os",),
+        "copyfileobj": ("shutil",),
+    }
+    #: Methods that write a file handle (receiver must ORIGINATE from an
+    #: open() call — reaching defs — or the method stays silent: .write on
+    #: buffers/sockets/tracers is not this rule's business).
+    _FILE_METHODS = ("write", "flush", "writelines")
+    #: Frames whose presence in the chain sanctions the I/O below them:
+    #: the flight recorder / metrics sampler ticks are throttled by
+    #: contract (their own modules own that budget).
+    _EXEMPT_FRAMES = ("maybe_snapshot", "metrics_tick")
+
+    def _io_call(self, call) -> "str | None":
+        q = qualname(call.func)
+        if not q:
+            return None
+        last = _last_segment(q)
+        roots = self._IO_FUNCS.get(last)
+        if roots is None:
+            return None
+        for root in roots:
+            if root == "" and q == last:
+                return last
+            if root and (q == f"{root}.{last}" or q.endswith(f".{root}.{last}")):
+                return f"{root}.{last}"
+        return None
+
+    @staticmethod
+    def _origin_is_open(o) -> bool:
+        return (
+            isinstance(o, ast.Call)
+            and _last_segment(qualname(o.func)) == "open"
+        )
+
+    def run_program(self, program):
+        from mapreduce_rust_tpu.analysis.dataflow import origins
+
+        seen: set[tuple[str, int]] = set()
+        for root in program.functions:
+            if root.name not in self._HOT:
+                continue
+            frames = [(root, [])] + program.reachable(root)
+            for fu, chain in frames:
+                if fu.name in self._EXEMPT_FRAMES or any(
+                    src.name in self._EXEMPT_FRAMES for src, _call in chain
+                ):
+                    continue
+                defs = reach = None
+                for call, _target in program.callees(fu):
+                    hit = self._io_call(call)
+                    if hit is None and isinstance(call.func, ast.Attribute) \
+                            and call.func.attr in self._FILE_METHODS:
+                        recv = call.func.value
+                        if self._origin_is_open(recv):
+                            hit = f"file.{call.func.attr}"
+                        elif isinstance(recv, ast.Name):
+                            if defs is None:
+                                defs, reach = fu.rd
+                            if any(
+                                self._origin_is_open(o)
+                                for o in origins(fu.cfg, defs, reach, recv)
+                            ):
+                                hit = f"file.{call.func.attr}"
+                    if hit is None:
+                        continue
+                    key = (fu.path, getattr(call, "lineno", 0))
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    via = (
+                        f" via {_call_chain(chain)} -> {fu.qualname}"
+                        if chain else ""
+                    )
+                    yield self.finding(
+                        fu.path, call,
+                        f"{hit!r} reached from fold/consumer hot scope "
+                        f"{root.qualname}{via} without going through the "
+                        "async spill-writer handoff — inline file I/O on "
+                        "the fold thread was the spill-engaged Zipf leg's "
+                        "15x collapse (ISSUE 11); freeze a snapshot and "
+                        "AsyncSpillWriter.submit it instead",
+                    )
+
+
 ALL_RULES: list[Rule] = [
     StatsOwnershipRule(),
     ExecutorTeardownRule(),
@@ -1310,4 +1430,5 @@ PROGRAM_RULES: list[ProgramRule] = [
     BackendInitInProbeRule(),
     NondeterministicPartitionRule(),
     CrossShardFoldRule(),
+    BlockingIoInFoldRule(),
 ]

@@ -133,6 +133,20 @@ class Config:
                                     # above this many words, and finalize
                                     # switches to the streaming merge-join
                                     # egress. None = all-RAM.
+    spill_async: bool = True        # binary async spill plane (ISSUE 11):
+                                    # budget flushes freeze a snapshot and
+                                    # a background writer thread per tier
+                                    # (each dictionary shard, the
+                                    # accumulator) sorts/packs/writes it
+                                    # while the fold keeps scanning —
+                                    # double-buffered, so memory stays
+                                    # O(2 x budget) per tier. False (or
+                                    # MR_SPILL_SYNC=1 for a whole process
+                                    # tree) restores the inline write: the
+                                    # debugging/measurement plane the
+                                    # bench's slow-disk chaos pair runs to
+                                    # show what the overlap hides. Outputs
+                                    # are bit-identical either way.
 
     # ---- Data-plane checkpointing (single-process mesh driver) ----
     checkpoint_every_groups: int = 0  # >0: after every N mesh groups, drain

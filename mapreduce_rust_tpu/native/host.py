@@ -86,6 +86,14 @@ def get_lib() -> ctypes.CDLL | None:
                 ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
                 ctypes.c_int64,
             ]
+            lib.mr_merge_runs.restype = ctypes.c_int64
+            lib.mr_merge_runs.argtypes = [
+                ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int64,
+            ]
         except (OSError, AttributeError) as e:
             # AttributeError: a stale .so (fresh mtime, old ABI) missing a
             # newer symbol must engage the Python fallback, not crash.
@@ -343,6 +351,47 @@ def scan_count_sharded_raw(
         pos[:count].copy(),
         shard_counts,
     )
+
+
+def merge_runs_stream(key_arrays, block: int = 1 << 16):
+    """Generator of (keys uint64[b], src int32[b], idx int64[b]) blocks
+    merged over K sorted key-disjoint uint64 columns — the native
+    loser-tree egress (ISSUE 11: loader.cpp ``mr_merge_runs``). Streams in
+    O(block) memory however large the runs are (columns may be memory
+    maps: the kernel reads them sequentially, so the OS pages them
+    through). Returns None when the native lib is unavailable — callers
+    fall back to the vectorized argsort merge (runtime/spill.py)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    arrays = [np.ascontiguousarray(a, dtype=np.uint64) for a in key_arrays]
+    k = len(arrays)
+
+    def gen():
+        ptrs = (ctypes.c_void_p * k)(*[a.ctypes.data for a in arrays])
+        lens = np.asarray([len(a) for a in arrays], dtype=np.int64)
+        cursors = np.zeros(k, dtype=np.int64)
+        out_keys = np.empty(block, dtype=np.uint64)
+        out_src = np.empty(block, dtype=np.int32)
+        out_idx = np.empty(block, dtype=np.int64)
+        while True:
+            n = int(lib.mr_merge_runs(
+                ptrs,
+                lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                k,
+                cursors.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                out_keys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                out_src.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                out_idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                block,
+            ))
+            if n <= 0:
+                return
+            # Copies: the kernel reuses the out buffers next call, and the
+            # consumer may hold a block across iterations.
+            yield out_keys[:n].copy(), out_src[:n].copy(), out_idx[:n].copy()
+
+    return gen()
 
 
 def scan_unique_raw(data: bytes) -> tuple[bytes, np.ndarray, np.ndarray] | None:

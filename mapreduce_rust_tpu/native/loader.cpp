@@ -554,6 +554,99 @@ int64_t mr_scan_count_sharded(const uint8_t* buf, int64_t len,
   return n;
 }
 
+// k-way disjoint merge over sorted uint64 key columns (ISSUE 11): the
+// batched loser-tree egress that replaces the per-key Python heap
+// interleave of the spill plane. The caller memory-maps each binary run's
+// key column and hands the pointers here; one call fills up to `block`
+// outputs — merged key, source index, index within source — and advances
+// `cursors` (caller-owned, so the merge streams in O(block) memory however
+// many keys the runs hold). Sources are key-DISJOINT by construction
+// (dictionary tiers + fold shards never share a key), so no dedup exists;
+// ties (impossible by that invariant, checked not assumed upstream) would
+// break toward the lower source index via the <= comparisons below.
+// Returns the number of outputs produced; 0 = every source exhausted.
+int64_t mr_merge_runs(const uint64_t** keys, const int64_t* lens, int64_t k,
+                      int64_t* cursors, uint64_t* out_keys, int32_t* out_src,
+                      int64_t* out_idx, int64_t block) {
+  if (k <= 0 || block <= 0) return 0;
+  if (k == 1) {  // degenerate merge: a straight copy of the remainder
+    int64_t n = 0;
+    while (n < block && cursors[0] < lens[0]) {
+      out_keys[n] = keys[0][cursors[0]];
+      out_src[n] = 0;
+      out_idx[n] = cursors[0];
+      ++cursors[0];
+      ++n;
+    }
+    return n;
+  }
+  // Loser tree over m = next-pow2(k) leaves; leaves >= k are permanently
+  // exhausted sentinels. `key[s]` caches source s's current head so the
+  // replay path never re-reads the (possibly page-faulting) mapped column
+  // twice for one comparison. Exhaustion is a FLAG, not a sentinel key:
+  // 0xFFFF...F is a legal packed key ((k1,k2) = (max,max)) — astronomically
+  // unlikely, but checked, not assumed (the house rule).
+  int64_t m = 1;
+  while (m < k) m <<= 1;
+  std::vector<uint64_t> key((size_t)k);
+  std::vector<uint8_t> alive((size_t)k, 0);
+  for (int64_t s = 0; s < k; ++s) {
+    if (cursors[s] < lens[s]) {
+      alive[s] = 1;
+      key[s] = keys[s][cursors[s]];
+    }
+  }
+  // does a beat b? (exhausted/virtual leaves lose to everything)
+  auto beats = [&](int32_t a, int32_t b) -> bool {
+    bool ba = a < k && alive[a], bb = b < k && alive[b];
+    if (!bb) return true;
+    if (!ba) return false;
+    return key[a] <= key[b];
+  };
+  // Build: play every leaf pair up the tree, storing losers at internal
+  // nodes; win[1] is the overall winner.
+  std::vector<int32_t> loser((size_t)m, (int32_t)k);  // k = virtual leaf
+  std::vector<int32_t> win((size_t)(2 * m));
+  for (int64_t i = 0; i < m; ++i) win[m + i] = (int32_t)i;
+  for (int64_t i = m - 1; i >= 1; --i) {
+    int32_t a = win[2 * i], b = win[2 * i + 1];
+    if (beats(a, b)) {
+      win[i] = a;
+      loser[i] = b;
+    } else {
+      win[i] = b;
+      loser[i] = a;
+    }
+  }
+  int32_t winner = win[1];
+  int64_t n = 0;
+  while (n < block) {
+    int32_t s = winner;
+    if (s >= k || !alive[s]) break;  // every source exhausted
+    out_keys[n] = key[s];
+    out_src[n] = s;
+    out_idx[n] = cursors[s];
+    ++n;
+    ++cursors[s];
+    if (cursors[s] < lens[s]) {
+      key[s] = keys[s][cursors[s]];
+    } else {
+      alive[s] = 0;
+    }
+    // Replay only s's leaf-to-root path: O(log k) per output.
+    int32_t w = s;
+    for (int64_t node = (m + s) >> 1; node >= 1; node >>= 1) {
+      if (!beats(w, loser[node])) {
+        int32_t tmp = w;
+        w = loser[node];
+        loser[node] = tmp;
+      }
+    }
+    winner = w;
+  }
+  return n;
+}
+
 // Normalize raw UTF-8 in one pass (the C replacement for
 // core/normalize.normalize_unicode — byte-exact by contract, proven by
 // tests/test_native.py):

@@ -29,6 +29,7 @@ from typing import Iterable, Iterator
 import numpy as np
 
 from mapreduce_rust_tpu.core.hashing import byte_class_tables, hash_words
+from mapreduce_rust_tpu.runtime import spill as spill_io
 
 
 def _delete_table() -> bytes:
@@ -110,21 +111,31 @@ class Dictionary:
 
     Bounded-memory tier (VERDICT r4 missing 3): with ``budget_words`` set,
     the word store flushes to a SORTED run file on disk
-    (``spill_dir/dictrun-*.txt``, 'k1 k2 word' lines ordered by packed key)
-    whenever it crosses the budget, keeping only the packed-key/length
-    arrays (8+8 bytes per word) in RAM for dedup + collision probing. A
-    spilled dictionary no longer serves point ``lookup`` for flushed words
-    — egress must consume ``iter_sorted()`` (the streaming merge-join in
-    runtime/driver.run_job does). Equal-length pair collisions on flushed
-    words pass undetected, the same degradation add_scanned_raw documents.
+    (``spill_dir/dictrun-*.bin``, the binary columnar format of
+    runtime/spill.py — packed-uint64 key column + varint lengths + word
+    bytes, ISSUE 11) whenever it crosses the budget, keeping only the
+    packed-key/length arrays (8+8 bytes per word) in RAM for dedup +
+    collision probing. The flush is a HANDOFF, not a write: the RAM tier
+    freezes into a snapshot and a background
+    :class:`~mapreduce_rust_tpu.runtime.spill.AsyncSpillWriter` sorts,
+    packs and writes it while this thread keeps scanning
+    (``async_spill=False`` / ``MR_SPILL_SYNC=1`` restores the inline
+    write). A spilled dictionary no longer serves point ``lookup`` for
+    flushed words — egress must consume ``iter_sorted()`` /
+    ``run_sources()`` (the streaming merge-join in runtime/driver.run_job
+    does). Equal-length pair collisions on flushed words pass undetected,
+    the same degradation add_scanned_raw documents.
     """
 
     def __init__(self, budget_words: int | None = None,
-                 spill_dir: str | None = None) -> None:
+                 spill_dir: str | None = None,
+                 async_spill: bool = True) -> None:
         if budget_words is not None and not spill_dir:
             raise ValueError("budget_words needs a spill_dir")
         self.budget_words = budget_words
         self.spill_dir = spill_dir
+        self.async_spill = async_spill
+        self._writer: "spill_io.AsyncSpillWriter | None" = None
         self._word_of: dict[tuple[int, int], bytes] = {}
         self._seen: set[bytes] = set()
         # (k1<<32)|k2 (always non-negative Python int) → stored word length.
@@ -181,37 +192,87 @@ class Dictionary:
             self._flush_words()
 
     def _flush_words(self) -> None:
-        """Spill the in-RAM word store as one sorted run file; keep only
-        the packed-key/length arrays for membership + collision probes."""
+        """Spill the in-RAM word store as one sorted binary run; keep only
+        the packed-key/length arrays for membership + collision probes.
+        The expensive half — ``np.argsort`` over the packed keys, the
+        varint pack, the write itself — runs on the background writer
+        thread against a FROZEN snapshot; this thread only swaps in fresh
+        containers and enqueues (spill backpressure, when the writer falls
+        two runs behind, is timed into the writer's ``stall_s``)."""
         if not self._word_of:
             return
-        from mapreduce_rust_tpu.runtime.trace import trace_span
-
         self._merge_fresh()
         os.makedirs(self.spill_dir, exist_ok=True)
+        run_index = len(self._runs)
         path = os.path.join(
             self.spill_dir,
-            f"dictrun-{os.getpid()}-{self._run_token}-{len(self._runs)}.txt",
+            spill_io.run_file_name("dictrun", self._run_token, run_index,
+                                   "bin"),
         )
-        tmp = path + ".tmp"
-        with trace_span("dictionary.flush", words=len(self._word_of),
-                        run=len(self._runs)):
-            with open(tmp, "wb") as f:
-                for (k1, k2), w in sorted(
-                    self._word_of.items(), key=lambda it: (it[0][0] << 32) | it[0][1]
-                ):
-                    f.write(b"%d %d %s\n" % (k1, k2, w))
-            os.replace(tmp, path)
+        # Freeze the RAM tier: the snapshot dict is never touched again by
+        # this thread (fresh containers swap in), so the writer reads it
+        # without a lock. Membership stays exact via _packed_sorted; the
+        # per-key dicts would otherwise grow unbounded beside the words.
+        snapshot = self._word_of
+        self._word_of = {}
+        self._seen = set()
+        self._len_of = {}
         self._runs.append(path)
-        self._word_of.clear()
-        self._seen.clear()
-        # Membership stays exact via _packed_sorted; the per-key dict would
-        # otherwise grow unbounded alongside the words it indexes.
-        self._len_of.clear()
+        token = self._run_token
+
+        def task() -> int:
+            from mapreduce_rust_tpu.runtime.trace import trace_span
+
+            with trace_span("dictionary.flush", words=len(snapshot),
+                            run=run_index):
+                keys, ends, buf = spill_io.pack_word_map(snapshot)
+                return spill_io.write_run_file(
+                    path, token, keys, ends, buf, run_index=run_index
+                )
+
+        self._ensure_writer().submit(task)
+
+    def _ensure_writer(self) -> "spill_io.AsyncSpillWriter":
+        self._writer = spill_io.ensure_writer(
+            self._writer, f"dict-spill-{self._run_token}",
+            sync=not self.async_spill,
+        )
+        return self._writer
+
+    def drain_spills(self) -> None:
+        """Barrier: every enqueued run is on disk (or the writer's error
+        re-raises here, on the owner thread). Called before any read of
+        the runs — egress merge, iter_sorted, save — and before final
+        spill accounting."""
+        if self._writer is not None:
+            self._writer.drain()
+
+    def close_spills(self, abort: bool = True) -> None:
+        """Stop the writer thread; pending snapshots are discarded on
+        abort (the caller is deleting the run files anyway). Idempotent,
+        never raises — exception-path teardown must not mask the job's
+        real error."""
+        if self._writer is not None:
+            self._writer.close(abort=abort)
+
+    def spill_stats(self) -> dict:
+        """Final spill accounting (collect AFTER drain/close): writer
+        seconds, owner stall seconds, bytes, runs, and the per-run
+        write_s histogram. Zeros when this dictionary never spilled."""
+        return spill_io.tier_spill_stats(self._writer, len(self._runs))
+
+    def spill_snapshot(self) -> "tuple[float, float, int] | None":
+        """(write_s, stall_s, bytes) right now — benign-stale reads of the
+        writer's float cells for the live metrics ring (the PR 9 fold
+        pattern: exact finals land at collect time). None = never spilled
+        (the common case stays two attribute reads)."""
+        return spill_io.tier_spill_snapshot(self._writer)
 
     def remove_runs(self) -> None:
         """Job-end cleanup of this dictionary's spill run files (the driver
-        owns the lifecycle)."""
+        owns the lifecycle). Closes the writer first — a run mid-write
+        must finish (or be discarded) before its file is unlinked."""
+        self.close_spills(abort=True)
         remove_run_files(self._runs)
 
     def _stored_len(self, packed: int) -> "int | None":
@@ -432,29 +493,28 @@ class Dictionary:
         self._guard_ram_only("items")
         return iter(self._word_of.items())
 
+    def run_sources(self) -> "list[spill_io.RunSource]":
+        """The key-disjoint sorted merge sources of this dictionary: every
+        binary disk run memory-mapped, plus the RAM tier packed with the
+        same vectorized argsort the flush uses. Drains the async writer
+        first — a run still in flight must hit disk before it is read."""
+        self.drain_spills()
+        sources = [spill_io.read_run_file(p) for p in self._runs]
+        if self._word_of:
+            keys, ends, buf = spill_io.pack_word_map(self._word_of)
+            sources.append(spill_io.RunSource(keys, ends, buf))
+        return sources
+
     def iter_sorted(self) -> Iterator[tuple[int, int, int, bytes]]:
         """(packed, k1, k2, word) over the WHOLE dictionary — disk runs
         plus the RAM tier — in ascending packed-key order. Tiers are
         key-disjoint by construction (membership spans both), so this is a
-        plain k-way merge with no dedup. The streaming-egress join consumes
-        this against the accumulator's sorted fold (runtime/driver)."""
-        import heapq
-
-        def run_iter(path):
-            with open(path, "rb") as f:
-                for line in f:
-                    a, b, w = line.rstrip(b"\n").split(b" ", 2)
-                    k1, k2 = int(a), int(b)
-                    yield ((k1 << 32) | k2, k1, k2, w)
-
-        def ram_iter():
-            for (k1, k2), w in sorted(
-                self._word_of.items(), key=lambda it: (it[0][0] << 32) | it[0][1]
-            ):
-                yield ((k1 << 32) | k2, k1, k2, w)
-
-        its = [run_iter(p) for p in self._runs] + [ram_iter()]
-        return heapq.merge(*its, key=lambda t: t[0])
+        plain k-way merge with no dedup, generated from the SAME block
+        merge the batched egress consumes (runtime/spill.merge_sources:
+        native loser tree over the memory-mapped key columns, argsort
+        fallback) — the per-line text parse this replaces was half the
+        spill-engaged egress wall (ISSUE 11)."""
+        return spill_io.iter_sources_sorted(self.run_sources())
 
     def merge(self, other: "Dictionary") -> None:
         if other.spilled:
@@ -480,23 +540,98 @@ class Dictionary:
     # merge them — the TPU analog of the reference's mr-{m}-{r}.txt files) --
 
     def save(self, path: str | os.PathLike) -> None:
-        """Words contain no whitespace bytes, so 'k1 k2 word' lines are safe;
-        collision events persist as '! kept rejected' lines so shard merges
-        never lose collision accounting. Disk runs stream through file to
-        file — a spilled dictionary saves without rehydrating into RAM."""
-        import shutil
-
+        """One binary container (the runtime/spill run format + a
+        collision section): the tiers merge into a single globally sorted
+        key column, and the word bytes STREAM to disk per merge block in
+        a second pass — a spilled dictionary saves in O(keys + block)
+        memory, never rehydrated into a Python dict (the bounded-memory
+        contract that made it spill in the first place). ``load`` sniffs
+        the magic, so pre-binary text saves (the 'k1 k2 word' /
+        '! kept rejected' line format) still load."""
+        sources = self.run_sources()  # drains the writer
+        # Pass 1: ONE k-way merge; the key/length columns plus the
+        # (src, idx) streams are retained (~28 B/key — small next to the
+        # word bytes, which never materialize whole). The header needs
+        # the totals up front, so the word bytes stream in pass 2 from
+        # the retained blocks without re-running the merge.
+        key_parts: list[np.ndarray] = []
+        len_parts: list[np.ndarray] = []
+        blocks: list[tuple] = []
+        for keys, src, idx in spill_io.merge_sources(sources):
+            key_parts.append(keys)
+            blocks.append((src, idx))
+            lens = np.empty(len(keys), dtype=np.int64)
+            for s in np.unique(src).tolist():
+                sel = np.nonzero(src == s)[0]
+                ends_arr = sources[s].ends
+                ii = idx[sel]
+                lens[sel] = ends_arr[ii] - np.where(
+                    ii > 0, ends_arr[ii - 1], 0
+                )
+            len_parts.append(lens)
+        if key_parts:
+            all_keys = np.ascontiguousarray(
+                np.concatenate(key_parts), dtype="<u8")
+            all_lens = np.concatenate(len_parts)
+        else:
+            all_keys = np.empty(0, dtype="<u8")
+            all_lens = np.empty(0, dtype=np.int64)
+        lens_b = spill_io.encode_varints(all_lens)
         with open(path, "wb") as f:
+            f.write(spill_io.pack_header_for_save(
+                self._run_token, len(all_keys), len(lens_b),
+                len(self.collisions),
+            ))
+            f.write(all_keys.tobytes())
+            f.write(lens_b)
+            # Pass 2: word bytes, one joined buffer per retained block.
+            for src, idx in blocks:
+                f.write(b"".join(
+                    spill_io.slice_block_words(sources, src, idx)
+                ))
             for kept, rejected in self.collisions:
-                f.write(b"! %s %s\n" % (kept, rejected))
-            for run in self._runs:
-                with open(run, "rb") as rf:
-                    shutil.copyfileobj(rf, f)
-            for (k1, k2), w in self._word_of.items():
-                f.write(b"%d %d %s\n" % (k1, k2, w))
+                f.write(spill_io.encode_varints(
+                    np.asarray([len(kept)], dtype=np.uint64)))
+                f.write(kept)
+                f.write(spill_io.encode_varints(
+                    np.asarray([len(rejected)], dtype=np.uint64)))
+                f.write(rejected)
 
     @classmethod
     def load(cls, path: str | os.PathLike) -> "Dictionary":
+        """Version-sniffing load (ISSUE 11 satellite): the binary magic
+        selects the columnar parse; anything else takes the legacy text
+        parse, so dictionaries saved by the text plane still load. An
+        unknown BINARY schema version fails loudly in read_run_header —
+        the migration exit path, never a silent misparse."""
+        with open(path, "rb") as f:
+            head = f.read(4)
+        if head == spill_io.RUN_MAGIC:
+            return cls._load_binary(path)
+        return cls._load_text(path)
+
+    @classmethod
+    def _load_binary(cls, path) -> "Dictionary":
+        d = cls()
+        src = spill_io.read_run_file(str(path))
+        d.collisions.extend(src.collisions)
+        keys = src.keys
+        ends = src.ends.tolist()
+        data = src.data
+        data_b = data if isinstance(data, bytes) else bytes(
+            memoryview(data))
+        start = 0
+        for packed, end in zip(keys.tolist(), ends):
+            w = data_b[start:end]
+            start = end
+            k1, k2 = packed >> 32, packed & 0xFFFFFFFF
+            if (k1, k2) not in d._word_of:
+                d._total_words += 1
+            d._insert_loaded(k1, k2, packed, w)
+        return d
+
+    @classmethod
+    def _load_text(cls, path) -> "Dictionary":
         d = cls()
         with open(path, "rb") as f:
             for line in f:
@@ -508,17 +643,19 @@ class Dictionary:
                 k1, k2 = int(a), int(b)
                 if (k1, k2) not in d._word_of:
                     d._total_words += 1
-                d._word_of[(k1, k2)] = w
-                d._seen.add(w)
-                packed = (k1 << 32) | k2
-                if packed not in d._len_of:
-                    d._len_of[packed] = len(w)
-                    # Every insert path must feed the vectorized tiers:
-                    # add_scanned_raw's membership is (merged | fresh), so
-                    # a loaded key that skipped them would be re-insertable.
-                    d._fresh_keys.append(packed)
-                    d._fresh_lens.append(len(w))
+                d._insert_loaded(k1, k2, (k1 << 32) | k2, w)
         return d
+
+    def _insert_loaded(self, k1: int, k2: int, packed: int, w: bytes) -> None:
+        self._word_of[(k1, k2)] = w
+        self._seen.add(w)
+        if packed not in self._len_of:
+            self._len_of[packed] = len(w)
+            # Every insert path must feed the vectorized tiers:
+            # add_scanned_raw's membership is (merged | fresh), so a
+            # loaded key that skipped them would be re-insertable.
+            self._fresh_keys.append(packed)
+            self._fresh_lens.append(len(w))
 
 
 class ShardedDictionary:
@@ -575,6 +712,59 @@ class ShardedDictionary:
         for s in self.shards:
             s.remove_runs()
 
+    def drain_spills(self) -> None:
+        for s in self.shards:
+            s.drain_spills()
+
+    def close_spills(self, abort: bool = True) -> None:
+        for s in self.shards:
+            s.close_spills(abort=abort)
+
+    def spill_stats(self) -> dict:
+        """Aggregate spill accounting over the shards (one async writer
+        per shard): write/stall seconds and bytes sum; the per-run write
+        histograms merge into one."""
+        from mapreduce_rust_tpu.runtime.histogram import Histogram
+
+        out = {"write_s": 0.0, "stall_s": 0.0, "bytes": 0, "runs": 0,
+               "hist": None}
+        hist = None
+        for s in self.shards:
+            st = s.spill_stats()
+            out["write_s"] += st["write_s"]
+            out["stall_s"] += st["stall_s"]
+            out["bytes"] += st["bytes"]
+            out["runs"] += st["runs"]
+            h = st["hist"]
+            if h is not None and h.count:
+                if hist is None:
+                    hist = Histogram()
+                hist.merge(h)
+        out["hist"] = hist
+        return out
+
+    def spill_snapshot(self) -> "tuple[float, float, int] | None":
+        total = None
+        for s in self.shards:
+            snap = s.spill_snapshot()
+            if snap is None:
+                continue
+            if total is None:
+                total = [0.0, 0.0, 0]
+            total[0] += snap[0]
+            total[1] += snap[1]
+            total[2] += snap[2]
+        return tuple(total) if total is not None else None
+
+    def run_sources(self) -> list:
+        """Every shard's merge sources in one flat list: shards are
+        key-disjoint like tiers, so the batched egress merges ALL of them
+        in one k-way pass — no per-shard interleave layer."""
+        out: list = []
+        for s in self.shards:
+            out.extend(s.run_sources())
+        return out
+
     def lookup(self, k1: int, k2: int) -> "bytes | None":
         return self.shards[self.shard_of(k1, k2)].lookup(k1, k2)
 
@@ -589,10 +779,7 @@ class ShardedDictionary:
         """(packed, k1, k2, word) over ALL shards in ascending packed-key
         order — the same contract Dictionary.iter_sorted serves, so the
         streaming merge-join egress is shard-count-blind. Shards are
-        key-disjoint, hence a dedup-free heap interleave of per-shard runs
-        (each itself a runs+RAM merge)."""
-        import heapq
-
-        return heapq.merge(
-            *(s.iter_sorted() for s in self.shards), key=lambda t: t[0]
-        )
+        key-disjoint, hence one flat dedup-free k-way merge over every
+        shard's runs + RAM tiers (ISSUE 11: the loser tree sees all
+        sources at once instead of a heap-of-heaps interleave)."""
+        return spill_io.iter_sources_sorted(self.run_sources())

@@ -319,6 +319,66 @@ def _build_step_fns(app: App, u_cap: int, use_pallas: bool = False):
     return map_combine, merge
 
 
+def _pack_key_cols(keys: np.ndarray) -> np.ndarray:
+    """[n, 2] (k1, k2) int64 columns (uint32-ranged by construction: they
+    are the device hash lanes) → one uint64 packed column. Packing turns
+    every key fold below into a 1-D sort/unique — np.unique(axis=0)'s
+    row-structured sort was the measured finalize wall of the spill-heavy
+    Zipf leg (ISSUE 11: ~4x slower than the 1-D path at 5M rows), and
+    packed order == (k1, k2) lexicographic order, so the fold's output
+    ordering is bit-identical."""
+    return (keys[:, 0].astype(np.uint64) << np.uint64(32)) | keys[:, 1].astype(
+        np.uint64
+    )
+
+
+def _unpack_rows(packed: np.ndarray, vals: np.ndarray) -> np.ndarray:
+    return np.column_stack([
+        (packed >> np.uint64(32)).astype(np.int64),
+        (packed & np.uint64(0xFFFFFFFF)).astype(np.int64),
+        vals.astype(np.int64),
+    ])
+
+
+def _combine_rows(op: str, keys: np.ndarray, vals: np.ndarray) -> np.ndarray:
+    """The shared fold kernel: (keys [n,2], vals [n]) → sorted deduped
+    rows [m, 3], value-keyed for "distinct", else folded per key. All key
+    work happens on the packed 1-D column (see _pack_key_cols)."""
+    packed = _pack_key_cols(keys)
+    if op == "distinct":
+        # Sort by (key, value) then mask repeats — same output order as
+        # np.unique over (k1, k2, value) rows, minus the structured sort.
+        order = np.lexsort((vals, packed))
+        p_s, v_s = packed[order], vals[order]
+        if len(p_s):
+            keep = np.empty(len(p_s), dtype=bool)
+            keep[0] = True
+            keep[1:] = (p_s[1:] != p_s[:-1]) | (v_s[1:] != v_s[:-1])
+            p_s, v_s = p_s[keep], v_s[keep]
+        return _unpack_rows(p_s, v_s)
+    uniq, inv = np.unique(packed, return_inverse=True)
+    inv = inv.reshape(-1)
+    if op == "sum":
+        folded = np.zeros(len(uniq), dtype=np.int64)
+        np.add.at(folded, inv, vals)
+    elif op == "max":
+        folded = np.full(len(uniq), np.iinfo(np.int64).min)
+        np.maximum.at(folded, inv, vals)
+    else:
+        folded = np.full(len(uniq), np.iinfo(np.int64).max)
+        np.minimum.at(folded, inv, vals)
+    return _unpack_rows(uniq, folded)
+
+
+def _combine_pending(op: str, keys_list, vals_list) -> np.ndarray:
+    """Combine pending (keys, vals) batches into sorted deduped rows
+    [n, 3] (k1, k2, value) — value-keyed for "distinct", else folded.
+    Module-level and pure so the async spill writer can run it against a
+    frozen snapshot off the consumer thread (ISSUE 11)."""
+    return _combine_rows(op, np.concatenate(keys_list),
+                         np.concatenate(vals_list))
+
+
 class HostAccumulator:
     """Exact host-side fold of device spills + the final state, per op.
 
@@ -333,24 +393,31 @@ class HostAccumulator:
     spill-heavy high-cardinality job holds O(budget + distinct) bytes
     instead of every spilled record — the tier the reference lacks (one
     ``Vec`` per partition holds the whole partition,
-    /root/reference/src/mr/worker.rs:82-108). ``fold_arrays()`` merges the
-    runs back exactly at finalize; ``.table`` (the Python-dict view) stays
-    for the in-RAM paths, while the streaming egress reads the arrays.
+    /root/reference/src/mr/worker.rs:82-108). The combine+write of each
+    run happens on a background :class:`AsyncSpillWriter` against frozen
+    pending arrays (ISSUE 11), so the consumer keeps draining the device
+    while the disk works; ``fold_arrays()`` drains the writer and merges
+    the runs back exactly at finalize; ``.table`` (the Python-dict view)
+    stays for the in-RAM paths, while the streaming egress reads the
+    arrays.
     """
 
     def __init__(self, op: str, budget_bytes: int | None = None,
-                 spill_dir: str | None = None) -> None:
+                 spill_dir: str | None = None,
+                 async_spill: bool = True) -> None:
         if budget_bytes is not None and not spill_dir:
             raise ValueError("budget_bytes needs a spill_dir")
         self.op = op
         self.budget_bytes = budget_bytes
         self.spill_dir = spill_dir
+        self.async_spill = async_spill
         self._keys: list[np.ndarray] = []   # each [N, 2] int64
         self._vals: list[np.ndarray] = []   # each [N] int64
         self._pending_bytes = 0
         self._runs: list[str] = []          # sorted, deduped [n,3] .npy files
         self._table: dict | None = None
         self._run_token = new_run_token()
+        self._writer = None
 
     def add(self, keys: np.ndarray, vals: np.ndarray) -> None:
         keys = np.asarray(keys, dtype=np.int64).reshape(-1, 2)
@@ -378,66 +445,87 @@ class HostAccumulator:
     def _pending_rows(self) -> np.ndarray:
         """Combine the in-RAM pending batches into sorted deduped rows
         [n, 3] (k1, k2, value) — value-keyed for "distinct", else folded."""
-        keys = np.concatenate(self._keys)
-        vals = np.concatenate(self._vals)
-        if self.op == "distinct":
-            return np.unique(np.column_stack([keys, vals]), axis=0)
-        uniq, inv = np.unique(keys, axis=0, return_inverse=True)
-        inv = inv.reshape(-1)
-        if self.op == "sum":
-            folded = np.zeros(len(uniq), dtype=np.int64)
-            np.add.at(folded, inv, vals)
-        elif self.op == "max":
-            folded = np.full(len(uniq), np.iinfo(np.int64).min)
-            np.maximum.at(folded, inv, vals)
-        else:
-            folded = np.full(len(uniq), np.iinfo(np.int64).max)
-            np.minimum.at(folded, inv, vals)
-        return np.column_stack([uniq, folded])
+        return _combine_pending(self.op, self._keys, self._vals)
 
     def _clear_pending(self) -> None:
         self._keys.clear()
         self._vals.clear()
         self._pending_bytes = 0
 
+    def _ensure_writer(self):
+        from mapreduce_rust_tpu.runtime.spill import ensure_writer
+
+        self._writer = ensure_writer(
+            self._writer, f"acc-spill-{self._run_token}",
+            sync=not self.async_spill,
+        )
+        return self._writer
+
     def _flush_run(self) -> None:
-        with trace_span("accumulator.flush_run", run=len(self._runs)):
-            rows = self._pending_rows()
-            self._clear_pending()
-            os.makedirs(self.spill_dir, exist_ok=True)
-            path = os.path.join(
-                self.spill_dir,
-                f"accrun-{os.getpid()}-{self._run_token}-{len(self._runs)}.npy",
-            )
-            tmp = path + ".tmp"
-            with open(tmp, "wb") as f:
-                np.save(f, rows)
-            os.replace(tmp, path)
-            self._runs.append(path)
-        log.info("host accumulator: spilled run %d (%d rows)", len(self._runs), len(rows))
+        """Freeze the pending batches and hand the combine + write to the
+        background writer (ISSUE 11): the np.unique fold AND the .npy
+        write run off the consumer thread; this thread only swaps in
+        fresh lists and enqueues."""
+        from mapreduce_rust_tpu.runtime.spill import (
+            run_file_name,
+            write_npy_run,
+        )
+
+        keys, vals = self._keys, self._vals
+        self._keys, self._vals = [], []
+        self._pending_bytes = 0
+        os.makedirs(self.spill_dir, exist_ok=True)
+        run_index = len(self._runs)
+        path = os.path.join(
+            self.spill_dir,
+            run_file_name("accrun", self._run_token, run_index, "npy"),
+        )
+        self._runs.append(path)
+        op = self.op
+
+        def task() -> int:
+            with trace_span("accumulator.flush_run", run=run_index):
+                rows = _combine_pending(op, keys, vals)
+                written = write_npy_run(path, rows, run_index=run_index)
+            log.info("host accumulator: spilled run %d (%d rows)",
+                     run_index + 1, len(rows))
+            return written
+
+        self._ensure_writer().submit(task)
+
+    def drain_spills(self) -> None:
+        """Barrier before any read of the run files (fold_arrays) or the
+        final accounting; re-raises a recorded writer error."""
+        if self._writer is not None:
+            self._writer.drain()
+
+    def close_spills(self, abort: bool = True) -> None:
+        if self._writer is not None:
+            self._writer.close(abort=abort)
+
+    def spill_stats(self) -> dict:
+        from mapreduce_rust_tpu.runtime.spill import tier_spill_stats
+
+        return tier_spill_stats(self._writer, len(self._runs))
+
+    def spill_snapshot(self) -> "tuple[float, float, int] | None":
+        from mapreduce_rust_tpu.runtime.spill import tier_spill_snapshot
+
+        return tier_spill_snapshot(self._writer)
 
     def remove_runs(self) -> None:
         """Job-end cleanup of this accumulator's spill run files (the
-        driver owns the lifecycle — see dictionary.remove_run_files)."""
+        driver owns the lifecycle — see dictionary.remove_run_files).
+        Closes the writer first so no run lands after its unlink."""
+        self.close_spills(abort=True)
         remove_run_files(self._runs)
 
     def _combine_sorted(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
-        """Merge two sorted deduped [n,3] row arrays into one."""
+        """Merge two sorted deduped [n,3] row arrays into one (the same
+        packed-column kernel as the pending fold — one implementation, so
+        the run merge and the pending combine cannot order differently)."""
         rows = np.concatenate([a, b])
-        if self.op == "distinct":
-            return np.unique(rows, axis=0)
-        uniq, inv = np.unique(rows[:, :2], axis=0, return_inverse=True)
-        inv = inv.reshape(-1)
-        if self.op == "sum":
-            folded = np.zeros(len(uniq), dtype=np.int64)
-            np.add.at(folded, inv, rows[:, 2])
-        elif self.op == "max":
-            folded = np.full(len(uniq), np.iinfo(np.int64).min)
-            np.maximum.at(folded, inv, rows[:, 2])
-        else:
-            folded = np.full(len(uniq), np.iinfo(np.int64).max)
-            np.minimum.at(folded, inv, rows[:, 2])
-        return np.column_stack([uniq, folded])
+        return _combine_rows(self.op, rows[:, :2], rows[:, 2])
 
     def fold_arrays(self) -> np.ndarray:
         """The exact fold as sorted rows [n, 3] (k1, k2, value) — one row
@@ -446,6 +534,7 @@ class HostAccumulator:
         equal-size partials merge first), so a K-run fold costs
         O(total log K) combine work instead of re-combining the full
         accumulated result once per run; peak memory stays O(result)."""
+        self.drain_spills()  # every enqueued run must be on disk first
         stack: list[tuple[int, np.ndarray]] = []  # (level, rows)
 
         def push(rows: np.ndarray) -> None:
@@ -1327,6 +1416,10 @@ def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
             # collect() writes the exact finals at teardown.
             stats.fold_s = sum(fold.fold_s)
             stats.fold_stall_s = fold.stall_s
+        # Running spill totals, same live-publication contract as fold_s:
+        # a spill-bound job must name "spill" in the live ring, not just
+        # in the post-mortem manifest (ISSUE 11).
+        _publish_spill_live(stats, dictionary, acc)
         maybe_snapshot()  # flight-recorder tick: per window, consumer thread
         metrics_tick()    # live-metrics sampler, same piggyback contract
         if len(pending) >= 2 * depth:
@@ -2051,6 +2144,47 @@ def _stream_mesh(cfg: Config, app: App, inputs, stats, acc, dictionary) -> None:
     _finish_mesh_state(app, mesh, state, stats, acc)
 
 
+def _collect_spill_stats(stats: JobStats, dictionary, acc) -> None:
+    """Fold the spill writers' final tallies into JobStats — run_job
+    thread only, AFTER remove_runs joined the writer threads, so no
+    write races exist (the fold-plane collect() doctrine). The per-run
+    write_s histograms merge into one ``spill.write_s`` distribution."""
+    d = dictionary.spill_stats()
+    a = acc.spill_stats()
+    stats.spill_s = d["write_s"] + a["write_s"]
+    stats.spill_stall_s = d["stall_s"] + a["stall_s"]
+    stats.spill_bytes = d["bytes"] + a["bytes"]
+    for h in (d["hist"], a["hist"]):
+        if h is not None and h.count:
+            agg = stats.hists.get("spill.write_s")
+            if agg is None:
+                agg = stats.hists["spill.write_s"] = Histogram()
+            agg.merge(h)
+
+
+def _publish_spill_live(stats: JobStats, dictionary, acc) -> None:
+    """Per-window live publication of the running spill totals (consumer/
+    router thread): the writers' float cells are benign-stale at worst —
+    the live metrics ring and the streaming doctor must see a spill-bound
+    job DURING the run, not only post-mortem (the PR 9 fold_s pattern).
+    Exact finals land in _collect_spill_stats at teardown."""
+    total_w = total_st = 0.0
+    total_b = 0
+    seen = False
+    for tier in (dictionary, acc):
+        snap = tier.spill_snapshot()
+        if snap is None:
+            continue
+        seen = True
+        total_w += snap[0]
+        total_st += snap[1]
+        total_b += snap[2]
+    if seen:
+        stats.spill_s = total_w
+        stats.spill_stall_s = total_st
+        stats.spill_bytes = total_b
+
+
 def run_job(
     cfg: Config,
     inputs: Sequence[str] | None = None,
@@ -2088,6 +2222,14 @@ def run_job(
     from mapreduce_rust_tpu.analysis.sanitize import new_dictionary, new_job_stats
 
     stats = new_job_stats(cfg)
+    # Crash-safe run scavenging (ISSUE 11 satellite): a SIGKILLed job's
+    # remove_runs never ran, so its dictrun-*/accrun-* files leak forever
+    # in a shared work_dir. Reclaim orphans whose writer pid is gone (live
+    # concurrent jobs keep answering kill(pid, 0), so theirs are never
+    # touched); best-effort, before this job's own tiers exist.
+    from mapreduce_rust_tpu.runtime.spill import scavenge_stale_runs
+
+    scavenge_stale_runs(cfg.work_dir, logger=log)
     acc = HostAccumulator(
         app.combine_op,
         budget_bytes=(
@@ -2095,6 +2237,7 @@ def run_job(
             if cfg.host_accum_budget_mb is not None else None
         ),
         spill_dir=cfg.work_dir,
+        async_spill=cfg.spill_async,
     )
     # Sharded egress fold (ISSUE 9): the single-process host-map engine
     # splits the dictionary into S key-hash-disjoint shards, each owned by
@@ -2115,12 +2258,14 @@ def run_job(
         )
         dictionary = ShardedDictionary([
             new_dictionary(cfg, budget_words=per_shard_budget,
-                           spill_dir=cfg.work_dir)
+                           spill_dir=cfg.work_dir,
+                           async_spill=cfg.spill_async)
             for _ in range(fold_shards)
         ])
     else:
         dictionary = new_dictionary(
-            cfg, budget_words=cfg.dictionary_budget_words, spill_dir=cfg.work_dir
+            cfg, budget_words=cfg.dictionary_budget_words,
+            spill_dir=cfg.work_dir, async_spill=cfg.spill_async,
         )
     # Compile instrumentation rides every run (cheap: two listeners, a
     # list append per compile); the slice below scopes the process-global
@@ -2255,8 +2400,11 @@ def run_job(
         # manifest) as the proof the disk tiers engaged.
         stats.accum_spill_runs = acc.run_count
         stats.dict_spill_runs = dictionary.run_count
+        # remove_runs closes (joins) every async spill writer, so the
+        # collection below reads FINAL counters — no thread still adding.
         acc.remove_runs()
         dictionary.remove_runs()
+        _collect_spill_stats(stats, dictionary, acc)
         if tracer is not None:
             stop_tracing()
         if tracer is not None or cfg.manifest_path:
@@ -2346,24 +2494,77 @@ def _stream_finalize(cfg: Config, app: App, stats: JobStats, acc: HostAccumulato
             ]
             matched = 0
             try:
-                i = 0
-                packed_l = packed_rows  # numpy scalar compares are fine here
-                for packed, k1, _k2, word in dictionary.iter_sorted():
-                    while i < n and int(packed_l[i]) < packed:
-                        i += 1  # fold key with no dictionary entry — counted below
-                    if i >= n:
+                # Batched k-way merge-join (ISSUE 11): the dictionary's
+                # sources (all runs, all shards, RAM tiers — key-disjoint
+                # by construction) merge in key/index BLOCKS through the
+                # native loser tree, and each block joins the fold with
+                # one vectorized searchsorted. Word bytes are sliced only
+                # for keys the fold actually holds — the per-key Python
+                # heap interleave + text parse this replaces was the
+                # spill-engaged egress wall.
+                from mapreduce_rust_tpu.runtime import spill as spill_io
+
+                sources = dictionary.run_sources()
+                stats.merge_fanin = len(sources)
+                merge_it = spill_io.merge_sources(sources)
+                while True:
+                    t0 = time.perf_counter()
+                    blk = next(merge_it, None)
+                    if blk is None:
                         break
-                    if int(packed_l[i]) != packed:
-                        continue  # dictionary word absent from the fold (filtered)
-                    j = i + 1
-                    while j < n and packed_l[j] == packed_l[i]:
-                        j += 1
-                    value = (
-                        sorted(rows[i:j, 2].tolist()) if is_distinct else int(rows[i, 2])
+                    keys_b, src_b, idx_b = blk
+                    ends_g = None
+                    if n:
+                        pos = np.searchsorted(packed_rows, keys_b)
+                        posc = np.minimum(pos, n - 1)
+                        hit = (pos < n) & (packed_rows[posc] == keys_b)
+                        if is_distinct:
+                            # Fold rows repeat per (key, doc): the group's
+                            # exclusive end, found once per block.
+                            ends_g = np.searchsorted(
+                                packed_rows, keys_b, side="right"
+                            )
+                    else:
+                        hit = np.zeros(len(keys_b), dtype=bool)
+                    stats.record_hist(
+                        "egress.merge_s", time.perf_counter() - t0
                     )
-                    parts[k1 % cfg.reduce_n].write(app.format_line(word, value) + b"\n")
-                    matched += 1
-                    i = j
+                    hits = np.nonzero(hit)[0]
+                    if not len(hits):
+                        continue  # dictionary words absent from the fold
+                    # Batched word slicing (spill_io.slice_block_words,
+                    # shared with the streaming save): word bytes are
+                    # materialized only for keys the fold holds — at
+                    # millions of matched words the per-item .word() path
+                    # was a measurable slice of egress.
+                    words = spill_io.slice_block_words(
+                        sources, src_b[hits], idx_b[hits]
+                    )
+                    rr = (
+                        (keys_b[hits] >> np.uint64(32)).astype(np.int64)
+                        % cfg.reduce_n
+                    ).tolist()
+                    pos_h = pos[hits]
+                    fmt = app.format_line
+                    # One buffered write per (block, partition), not one
+                    # per line: the formatted lines batch through a join.
+                    blk_lines: list[list] = [[] for _ in range(cfg.reduce_n)]
+                    if is_distinct:
+                        for w, r, i, j2 in zip(
+                            words, rr, pos_h.tolist(), ends_g[hits].tolist()
+                        ):
+                            blk_lines[r].append(
+                                fmt(w, sorted(rows[i:j2, 2].tolist()))
+                            )
+                    else:
+                        for w, r, v in zip(
+                            words, rr, rows[pos_h, 2].tolist()
+                        ):
+                            blk_lines[r].append(fmt(w, v))
+                    for r, ls in enumerate(blk_lines):
+                        if ls:
+                            parts[r].write(b"\n".join(ls) + b"\n")
+                    matched += len(hits)
             finally:
                 for f in parts:
                     f.close()
@@ -2374,15 +2575,14 @@ def _stream_finalize(cfg: Config, app: App, stats: JobStats, acc: HostAccumulato
                 with open(os.path.join(tmpdir, f"part-{r}"), "rb") as f:
                     lines = f.read().splitlines()
                 lines.sort()
-                # Same reduce-skew signal as the in-RAM egress path.
-                stats.partition_bytes.append(
-                    sum(len(line) + 1 for line in lines)
-                )
+                buf = b"\n".join(lines) + b"\n" if lines else b""
+                # Same reduce-skew signal as the in-RAM egress path (the
+                # joined buffer's length IS sum(len(line) + 1)).
+                stats.partition_bytes.append(len(buf))
                 if write_outputs:
                     path = os.path.join(cfg.output_dir, f"mr-{r}.txt")
                     with open(path, "wb") as f:
-                        for line in lines:
-                            f.write(line + b"\n")
+                        f.write(buf)
                     output_files.append(path)
         finally:
             import shutil

@@ -109,6 +109,20 @@ class JobStats:
     # doctor's fold-shard-skew finding scores
     fold_shard_idle_s: list = dataclasses.field(default_factory=list)
     # per-shard seconds the fold thread sat waiting for routed work
+    # ---- binary async spill plane (ISSUE 11) ----
+    spill_s: float = 0.0          # background-writer seconds spent
+    # sorting/packing/writing spill runs (dictionary + accumulator tiers,
+    # aggregate across writer threads — overlapped with the scan, so with
+    # the async plane this can exceed nothing: it is hidden time made
+    # visible)
+    spill_stall_s: float = 0.0    # fold/consumer wall seconds blocked on
+    # a full spill-writer queue: the wall-clock "the disk is the ceiling"
+    # signal, exactly as fold_stall_s is for the fold — large means raise
+    # the budgets (fewer, larger runs), add fold shards (one writer per
+    # shard), or find a faster disk
+    spill_bytes: int = 0          # bytes written to spill runs (both tiers)
+    merge_fanin: int = 0          # sources the egress k-way merge saw
+    # (runs + RAM tiers across every shard; 0 = in-RAM egress)
     scan_wait_s: float = 0.0      # consumer wall time blocked waiting for
     # the next IN-ORDER scan result: the parallel engine's starvation
     # signal — large scan_wait means more workers (or a faster scan) would
@@ -186,6 +200,13 @@ class JobStats:
             # wall-clock "the fold is the ceiling" signal is the router's
             # fold backpressure, same logic as scan_wait_s for the scans.
             parts["host-fold"] = self.fold_stall_s
+        if self.spill_s > 0 or self.spill_stall_s > 0:
+            # Async spill plane (ISSUE 11): run writes happen off the hot
+            # threads, so the honest "the disk is the ceiling" signal is
+            # the owner-side writer backpressure — the same stall logic as
+            # host-fold. (The doctor's _bottleneck_attribution mirrors
+            # this arm exactly; keep them in lockstep.)
+            parts["spill"] = self.spill_stall_s
         name, val = max(parts.items(), key=lambda kv: kv[1])
         return name if val > 0 else "balanced"
 
@@ -223,6 +244,10 @@ class JobStats:
                 f" fold={self.fold_s:.2f}s/{self.fold_shards}sh "
                 f"fstall={self.fold_stall_s:.2f}s"
                 if self.fold_shards > 1 else ""
+            )
+            + (
+                f" spillw={self.spill_s:.2f}s sstall={self.spill_stall_s:.2f}s"
+                if self.spill_s > 0 or self.spill_stall_s > 0 else ""
             )
             + f" → {self.bottleneck}] [{phases}]"
         )
@@ -595,6 +620,9 @@ def jobstats_collector(stats: JobStats):
             "job.host_glue_s": round(stats.host_glue_s, 6),
             "job.fold_s": round(stats.fold_s, 6),
             "job.fold_stall_s": round(stats.fold_stall_s, 6),
+            "job.spill_s": round(stats.spill_s, 6),
+            "job.spill_stall_s": round(stats.spill_stall_s, 6),
+            "job.spill_bytes": stats.spill_bytes,
             "job.scan_wait_s": round(stats.scan_wait_s, 6),
             "job.all_to_all_s": round(stats.all_to_all_s, 6),
             "job.mesh_rounds": stats.mesh_rounds,

@@ -602,6 +602,28 @@ def stats_to_dict(stats) -> dict:
                 round(stats.fold_s / stream_s, 3) if stream_s else None
             ),
         }
+    if stats.dict_spill_runs or stats.accum_spill_runs or stats.spill_bytes:
+        # Binary async spill plane (ISSUE 11): the disk-tier attribution —
+        # writer seconds (overlapped with compute), owner stall seconds
+        # (backpressure = "the disk is the ceiling"), bytes, run counts,
+        # the egress merge fan-in, and the run format so every manifest
+        # says which plane produced its numbers.
+        from mapreduce_rust_tpu.runtime.spill import RUN_FORMAT
+
+        d["spill_split"] = {
+            "format": RUN_FORMAT,
+            "write_s": round(stats.spill_s, 6),
+            "stall_s": round(stats.spill_stall_s, 6),
+            "bytes": stats.spill_bytes,
+            "dict_runs": stats.dict_spill_runs,
+            "accum_runs": stats.accum_spill_runs,
+            "merge_fanin": stats.merge_fanin,
+            # writer seconds overlapped per stream second — >0 means the
+            # old sync plane would have added that fraction to the wall.
+            "write_overlap": (
+                round(stats.spill_s / stream_s, 3) if stream_s else None
+            ),
+        }
     if stats.mesh_rounds > 0:
         d["ici_split"] = {
             "rounds": stats.mesh_rounds,
@@ -798,6 +820,15 @@ def format_manifest(m: dict) -> str:
                 f"(x{fs['fold_parallelism'] or 0:.2f} parallel, "
                 f"balance {fs['balance'] or 0:.2f}) "
                 f"stall={fs['fold_stall_s']:.3f}s"
+            )
+        sp = s.get("spill_split")
+        if sp:
+            lines.append(
+                f"  spill split [{sp.get('format')}]: "
+                f"write={sp['write_s']:.3f}s stall={sp['stall_s']:.3f}s "
+                f"{sp['bytes'] / 1e6:.1f} MB in "
+                f"{sp['dict_runs']}+{sp['accum_runs']} runs "
+                f"(egress fan-in {sp['merge_fanin']})"
             )
         ici = s.get("ici_split")
         if ici:

@@ -1192,3 +1192,92 @@ def test_cross_shard_fold_silent_without_shard_param(tmp_path):
             return shards[(k1 << 32 | k2) % len(shards)].lookup(k1, k2)
     """)
     assert fired == []
+
+
+# ---------------------------------------------------------------------------
+# Rule 13 blocking-io-in-fold (ISSUE 11): the fold/consumer hot scopes do
+# file I/O only through the async spill-writer handoff.
+# ---------------------------------------------------------------------------
+
+def test_blocking_io_in_fold_fires_on_direct_open(tmp_path):
+    fired, report = program_rules_fired(tmp_path, """
+        def _fold_one(shard, item):
+            with open("/tmp/run.bin", "wb") as f:
+                f.write(item)
+    """)
+    assert fired == ["blocking-io-in-fold"]
+    assert "_fold_one" in report.findings[0].message
+
+
+def test_blocking_io_in_fold_follows_sync_helpers(tmp_path):
+    # The pre-ISSUE-11 shipped shape: the run write hides one frame down
+    # from the fold mutator (_flush_words called open inline).
+    fired, report = program_rules_fired(tmp_path, """
+        def write_run(path, raw):
+            f = open(path, "wb")
+            f.write(raw)
+            f.flush()
+
+        def _flush_words(path, raw):
+            write_run(path, raw)
+
+        def _maybe_flush(path, raw):
+            _flush_words(path, raw)
+    """)
+    assert fired == ["blocking-io-in-fold"]
+    assert "via" in report.findings[0].message
+
+
+def test_blocking_io_in_fold_fires_on_np_save(tmp_path):
+    fired, _ = program_rules_fired(tmp_path, """
+        import numpy as np
+
+        def _flush_run(rows, path):
+            with open(path, "wb") as f:
+                np.save(f, rows)
+    """)
+    assert fired == ["blocking-io-in-fold"]
+
+
+def test_blocking_io_in_fold_silent_on_writer_handoff(tmp_path):
+    # The sanctioned shape: freeze a snapshot, submit the task — the
+    # executor-sink boundary makes the task's body the WRITER thread's
+    # business, exactly like run_in_executor for blocking-in-async.
+    fired, _ = program_rules_fired(tmp_path, """
+        def _write_run(path, snapshot):
+            with open(path, "wb") as f:
+                f.write(snapshot)
+
+        def _flush_words(self, path):
+            snapshot = dict(self.words)
+            self.writer.submit(lambda: _write_run(path, snapshot))
+
+        def add_scanned_raw(self, path):
+            self._flush_words(path)
+    """)
+    assert fired == []
+
+
+def test_blocking_io_in_fold_silent_on_throttled_snapshot(tmp_path):
+    # maybe_snapshot/metrics_tick frames are exempt: the flight recorder
+    # and the sampler own their throttling budgets.
+    fired, _ = program_rules_fired(tmp_path, """
+        def maybe_snapshot(buf, path):
+            with open(path, "w") as f:
+                f.write(buf)
+
+        def consume(result, buf, path):
+            maybe_snapshot(buf, path)
+    """)
+    assert fired == []
+
+
+def test_blocking_io_in_fold_silent_outside_hot_scopes(tmp_path):
+    # The same I/O in a non-hot function (egress, checkpoints) is fine.
+    fired, _ = program_rules_fired(tmp_path, """
+        def _stream_finalize(path, lines):
+            with open(path, "wb") as f:
+                for line in lines:
+                    f.write(line)
+    """)
+    assert fired == []

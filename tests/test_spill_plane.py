@@ -1,0 +1,514 @@
+"""Binary async spill plane (ISSUE 11): run-format roundtrips, the k-way
+merge, async-writer equivalence + failure containment, save/load version
+sniffing, crash-safe run scavenging, and the slow_disk chaos site.
+
+The load-bearing contract: outputs are BIT-IDENTICAL to the in-RAM plane
+across the whole (host_map_workers, fold_shards, budget) matrix, async or
+sync, native merge or numpy fallback — the spill plane is a scheduling
+and format change, never a data change."""
+
+import glob
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from mapreduce_rust_tpu.apps import InvertedIndex
+from mapreduce_rust_tpu.config import Config
+from mapreduce_rust_tpu.runtime import spill
+from mapreduce_rust_tpu.runtime.dictionary import Dictionary
+from mapreduce_rust_tpu.runtime.driver import HostAccumulator, run_job
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Format primitives
+# ---------------------------------------------------------------------------
+
+def test_varint_roundtrip_vectorized():
+    rng = np.random.default_rng(7)
+    for vals in (
+        [],
+        [0],
+        [127, 128, 129],
+        [1 << 14, (1 << 14) - 1, 1 << 21, 1 << 63],
+        rng.integers(0, 1 << 40, size=5000).tolist(),
+    ):
+        arr = np.asarray(vals, dtype=np.uint64)
+        enc = spill.encode_varints(arr)
+        dec = spill.decode_varints(np.frombuffer(enc, np.uint8), len(arr))
+        assert np.array_equal(dec, arr)
+    # Single-byte fast shape: lengths < 128 encode to exactly n bytes.
+    assert len(spill.encode_varints(np.arange(100, dtype=np.uint64))) == 100
+
+
+def test_varint_decode_rejects_torn_sections():
+    enc = spill.encode_varints(np.asarray([300, 5], dtype=np.uint64))
+    with pytest.raises(ValueError):
+        spill.decode_varints(np.frombuffer(enc, np.uint8), 3)  # miscounted
+    with pytest.raises(ValueError):
+        spill.decode_varints(np.frombuffer(enc[:-1], np.uint8), 2)  # torn
+
+
+def test_run_file_roundtrip_and_version_sniff(tmp_path):
+    word_of = {(i * 3, i * 7 + 1): f"word{i:04d}".encode() for i in range(500)}
+    word_of[(0, 0)] = b""  # empty word survives the format
+    keys, ends, buf = spill.pack_word_map(word_of)
+    assert list(keys) == sorted(keys)  # argsort'd packed order
+    p = str(tmp_path / "dictrun-1-00000000-0.bin")
+    written = spill.write_run_file(p, "00000000", keys, ends, buf)
+    assert written == os.path.getsize(p)
+    src = spill.read_run_file(p)
+    assert np.array_equal(src.keys, keys)
+    got = {(int(k) >> 32, int(k) & 0xFFFFFFFF): src.word(i)
+           for i, k in enumerate(src.keys)}
+    assert got == word_of
+    # Version sniff exit path: a bumped schema version fails LOUDLY.
+    raw = bytearray(pathlib.Path(p).read_bytes())
+    raw[4] = 99
+    bad = tmp_path / "bad.bin"
+    bad.write_bytes(bytes(raw))
+    with pytest.raises(ValueError, match="version"):
+        spill.read_run_file(str(bad))
+    with pytest.raises(ValueError, match="magic"):
+        spill.read_run_file(__file__)  # not a run at all
+
+
+def test_merge_sources_native_matches_fallback(monkeypatch):
+    rng = np.random.default_rng(11)
+    # Key-disjoint sorted sources of uneven sizes, one empty.
+    pool = np.unique(rng.integers(0, 1 << 48, size=30000).astype(np.uint64))
+    owner = rng.integers(0, 4, size=len(pool))
+    sources = []
+    for s in range(4):
+        ks = np.sort(pool[owner == s]) if s != 2 else np.empty(0, np.uint64)
+        ends = np.arange(1, len(ks) + 1, dtype=np.int64)
+        sources.append(spill.RunSource(ks, ends, b"x" * len(ks)))
+
+    def collect():
+        rows = []
+        for keys, src, idx in spill.merge_sources(sources, block=777):
+            rows.extend(zip(keys.tolist(), src.tolist(), idx.tolist()))
+        return rows
+
+    native = collect()
+    keys_only = [k for k, _, _ in native]
+    assert keys_only == sorted(keys_only)
+    assert len(native) == int((owner != 2).sum())
+    # Every (src, idx) points at the key it claims.
+    for k, s, i in native[:2000]:
+        assert int(sources[s].keys[i]) == k
+    # Force the numpy fallback and compare exactly.
+    from mapreduce_rust_tpu.native import host as native_host
+
+    monkeypatch.setattr(native_host, "merge_runs_stream",
+                        lambda *a, **kw: None)
+    assert collect() == native
+
+
+# ---------------------------------------------------------------------------
+# Dictionary: async flush, equivalence, save/load
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("async_spill", [True, False])
+def test_dictionary_binary_spill_matches_plain(tmp_path, async_spill):
+    plain = Dictionary()
+    tiered = Dictionary(budget_words=64, spill_dir=str(tmp_path),
+                        async_spill=async_spill)
+    words = [f"word{i:04d}".encode() for i in range(500)]
+    for start in range(0, 500, 50):
+        batch = words[start:start + 50] + words[:10]
+        plain.add_words(batch)
+        tiered.add_words(batch)
+    assert tiered.spilled
+    assert glob.glob(str(tmp_path / "dictrun-*.bin"))  # binary runs on disk
+    assert len(tiered) == len(plain) == 500
+    got = list(tiered.iter_sorted())
+    want = sorted(
+        (((k1 << 32) | k2, k1, k2, w) for (k1, k2), w in plain.items()),
+        key=lambda t: t[0],
+    )
+    assert got == want
+    st = tiered.spill_stats()
+    assert st["runs"] >= 2 and st["bytes"] > 0 and st["write_s"] >= 0
+    tiered.remove_runs()
+    assert not glob.glob(str(tmp_path / "dictrun-*"))
+
+
+def test_dictionary_save_load_binary_roundtrip(tmp_path):
+    d = Dictionary(budget_words=32, spill_dir=str(tmp_path))
+    words = [f"tok{i:03d}".encode() for i in range(200)]
+    d.add_words(words)
+    d.collisions.append((b"kept", b"rejected"))
+    assert d.spilled
+    p = tmp_path / "dict.bin"
+    d.save(p)  # spilled save: merged runs + RAM tier + collision section
+    d2 = Dictionary.load(p)
+    assert len(d2) == 200
+    assert d2.collisions == [(b"kept", b"rejected")]
+    assert sorted(w for _p, _k1, _k2, w in d2.iter_sorted()) == sorted(words)
+    # Re-ingesting loaded words must not double count (membership fed).
+    assert d2.add_words(words[:50]) == 0
+
+
+def test_dictionary_load_sniffs_legacy_text_format(tmp_path):
+    # A dictionary saved by the TEXT plane (pre-ISSUE 11 'k1 k2 word' +
+    # '! kept rejected' lines) still loads — the version-sniff migration.
+    from mapreduce_rust_tpu.core.hashing import hash_word
+
+    p = tmp_path / "legacy.txt"
+    lines = [b"! keptword impostor"]
+    words = [b"alpha", b"beta", b"gamma"]
+    for w in words:
+        k1, k2 = hash_word(w)
+        lines.append(b"%d %d %s" % (k1, k2, w))
+    p.write_bytes(b"\n".join(lines) + b"\n")
+    d = Dictionary.load(p)
+    assert len(d) == 3
+    assert d.collisions == [(b"keptword", b"impostor")]
+    k1, k2 = hash_word(b"beta")
+    assert d.lookup(k1, k2) == b"beta"
+    # And a binary re-save of the loaded dictionary loads identically.
+    p2 = tmp_path / "resaved.bin"
+    d.save(p2)
+    d2 = Dictionary.load(p2)
+    assert {w for _p, _a, _b, w in d2.iter_sorted()} == set(words)
+    assert d2.collisions == d.collisions
+
+
+def test_writer_death_reraises_and_never_deadlocks(tmp_path, monkeypatch):
+    # Disk-full mid-run: the writer records the error and keeps draining;
+    # the owner's bounded submit never deadlocks and the ORIGINAL error
+    # surfaces on the owner thread (at a later flush or at drain).
+    calls = [0]
+    orig = spill.write_run_file
+
+    def boom(path, token, keys, ends, buf, run_index=0, collisions=()):
+        calls[0] += 1
+        if calls[0] >= 2:
+            raise OSError(28, "No space left on device")
+        return orig(path, token, keys, ends, buf, run_index=run_index,
+                    collisions=collisions)
+
+    monkeypatch.setattr(spill, "write_run_file", boom)
+    d = Dictionary(budget_words=16, spill_dir=str(tmp_path))
+    t0 = time.monotonic()
+    with pytest.raises(OSError, match="No space left"):
+        for i in range(40):  # many flushes: submit must hit the poison
+            d.add_words([f"w{i:03d}-{j}".encode() for j in range(16)])
+        d.drain_spills()
+    assert time.monotonic() - t0 < 30  # bounded queue never deadlocked
+    d.remove_runs()  # idempotent teardown after death
+    assert not glob.glob(str(tmp_path / "dictrun-*"))
+
+
+def test_disk_full_job_unwinds_without_orphans(tmp_path, monkeypatch):
+    # End-to-end seeded failure: every spill write fails; run_job must
+    # surface the error, reap its threads, and leave no arenas or .tmp
+    # run files behind (ISSUE 11 satellite).
+    import gc
+
+    from mapreduce_rust_tpu.native import host as native_host
+
+    def boom(*a, **kw):
+        raise OSError(28, "No space left on device")
+
+    monkeypatch.setattr(spill, "write_run_file", boom)
+    gc.collect()
+    baseline = native_host.arena_count()
+    d = tmp_path / "in"
+    d.mkdir()
+    p = d / "doc.txt"
+    p.write_bytes(" ".join(f"tok{i:05d}" for i in range(3000)).encode())
+    cfg = Config(
+        map_engine="host", host_window_bytes=4096, merge_capacity=512,
+        chunk_bytes=8192, dictionary_budget_words=64,
+        work_dir=str(tmp_path / "work"), output_dir=str(tmp_path / "out"),
+        device="cpu",
+    )
+    with pytest.raises(OSError, match="No space left"):
+        run_job(cfg, [str(p)])
+    gc.collect()
+    assert native_host.arena_count() <= baseline
+    leftovers = glob.glob(str(tmp_path / "work" / "dictrun-*")) + \
+        glob.glob(str(tmp_path / "work" / "*.tmp"))
+    assert leftovers == []
+
+
+# ---------------------------------------------------------------------------
+# End-to-end equivalence matrix
+# ---------------------------------------------------------------------------
+
+TEXTS = [
+    "the quick brown fox jumps over the lazy dog " * 300
+    + " ".join(f"w{i:05d}" for i in range(2500)),
+    "pack my box with five dozen liquor jugs " * 250
+    + " ".join(f"v{i:05d}" for i in range(1500)),
+]
+
+
+def _write_inputs(tmp_path):
+    paths = []
+    for i, t in enumerate(TEXTS):
+        p = tmp_path / f"doc-{i}.txt"
+        p.write_bytes(t.encode())
+        paths.append(str(p))
+    return paths
+
+
+def _outputs(cfg):
+    return {
+        pathlib.Path(p).name: pathlib.Path(p).read_bytes()
+        for p in glob.glob(str(pathlib.Path(cfg.output_dir) / "mr-*.txt"))
+    }
+
+
+def _cfg(tmp_path, tag, **kw):
+    defaults = dict(
+        map_engine="host", host_window_bytes=4096, chunk_bytes=8192,
+        merge_capacity=512, reduce_n=4, device="cpu",
+        work_dir=str(tmp_path / f"work-{tag}"),
+        output_dir=str(tmp_path / f"out-{tag}"),
+    )
+    defaults.update(kw)
+    return Config(**defaults)
+
+
+@pytest.mark.parametrize("app_factory", [None, InvertedIndex],
+                         ids=["word_count", "inverted_index"])
+def test_matrix_budget_workers_shards_bit_identical(tmp_path, app_factory):
+    # The ISSUE 11 equivalence matrix: {W}x{S}x{budget} on word-count and
+    # inverted-index — outputs bit-identical to the in-RAM plane, spill
+    # totals identical across the matrix, async and sync both.
+    paths = _write_inputs(tmp_path)
+    app = app_factory() if app_factory else None
+    ram = run_job(_cfg(tmp_path, "ram"), paths, app=app)
+    base = _outputs(_cfg(tmp_path, "ram"))
+    assert base
+    first_spill = None
+    combos = [
+        (1, 1, 128, True), (2, 2, 128, True), (2, 4, 64, True),
+        (1, 1, 128, False),  # the sync plane: identical bytes, same runs
+    ]
+    for w, s, budget, async_spill in combos:
+        tag = f"w{w}s{s}b{budget}{'a' if async_spill else 'y'}"
+        cfg = _cfg(tmp_path, tag, host_map_workers=w, fold_shards=s,
+                   dictionary_budget_words=budget, host_accum_budget_mb=1,
+                   spill_async=async_spill)
+        res = run_job(cfg, paths, app=app)
+        assert res.stats.dict_spill_runs > 0, tag
+        assert res.table == {}  # streaming egress engaged
+        assert _outputs(cfg) == base, tag
+        assert res.stats.unknown_keys == 0
+        assert res.stats.distinct_keys == ram.stats.distinct_keys
+        assert res.stats.merge_fanin >= 2, tag
+        if first_spill is None:
+            first_spill = res
+        else:
+            assert res.stats.spilled_keys == first_spill.stats.spilled_keys
+
+
+def test_spill_split_manifest_and_doctor_attribution(tmp_path):
+    from mapreduce_rust_tpu.analysis.doctor import diagnose
+    from mapreduce_rust_tpu.runtime import telemetry
+
+    paths = _write_inputs(tmp_path)
+    cfg = _cfg(tmp_path, "manifest", dictionary_budget_words=128,
+               host_accum_budget_mb=1,
+               manifest_path=str(tmp_path / "manifest.json"))
+    res = run_job(cfg, paths)
+    m = telemetry.load_manifest(cfg.manifest_path)
+    split = m["stats"]["spill_split"]
+    assert split["format"] == spill.RUN_FORMAT
+    assert split["dict_runs"] == res.stats.dict_spill_runs > 0
+    assert split["bytes"] > 0
+    assert split["merge_fanin"] == res.stats.merge_fanin >= 2
+    assert m["stats"]["histograms"]["spill.write_s"]["count"] > 0
+    assert m["stats"]["histograms"]["egress.merge_s"]["count"] > 0
+    # Doctor mirrors JobStats.bottleneck exactly and carries the spill
+    # component when the plane engaged.
+    diag = diagnose(m)
+    bn = diag["bottleneck"]
+    assert bn["agrees_with_stats"], bn
+    assert "spill" in {a["component"] for a in bn["attribution"]}
+
+
+def test_doctor_spill_bound_finding_and_live_agg():
+    from mapreduce_rust_tpu.analysis.doctor import (
+        _bottleneck_attribution,
+        diagnose,
+    )
+
+    manifest = {
+        "kind": "run_manifest",
+        "stats": {
+            "spill_s": 2.0, "spill_stall_s": 5.0, "host_glue_s": 0.4,
+            "ingest_wait_s": 0.1, "device_wait_s": 0.2,
+            "spill_split": {"bytes": 10 << 20, "dict_runs": 8,
+                            "accum_runs": 2},
+        },
+    }
+    diag = diagnose(manifest)
+    assert diag["bottleneck"]["name"] == "spill"
+    assert "spill-bound" in {f["code"] for f in diag["findings"]}
+    # Live fleet aggregates carry no fold_shards/spill_split: presence of
+    # the stall series alone arms the component (streaming doctor).
+    live = _bottleneck_attribution({"spill_stall_s": 3.0, "spill_s": 1.0,
+                                    "host_glue_s": 0.5})
+    assert live["name"] == "spill"
+    # No spill engagement → no spill component at all.
+    quiet = _bottleneck_attribution({"host_glue_s": 0.5})
+    assert "spill" not in {a["component"] for a in quiet["attribution"]}
+
+
+def test_jobstats_collector_ships_spill_series():
+    from mapreduce_rust_tpu.runtime.metrics import JobStats, jobstats_collector
+
+    st = JobStats()
+    st.spill_s, st.spill_stall_s, st.spill_bytes = 1.5, 0.25, 4096
+    vals = jobstats_collector(st)()
+    assert vals["job.spill_s"] == 1.5
+    assert vals["job.spill_stall_s"] == 0.25
+    assert vals["job.spill_bytes"] == 4096
+
+
+# ---------------------------------------------------------------------------
+# Crash-safe scavenging
+# ---------------------------------------------------------------------------
+
+def test_scavenger_removes_orphans_keeps_live(tmp_path):
+    d = str(tmp_path)
+    dead_pid = 999999  # beyond pid_max defaults: no such process
+    orphan = tmp_path / f"dictrun-{dead_pid}-aabbccdd-0.bin"
+    orphan_tmp = tmp_path / f"accrun-{dead_pid}-aabbccdd-1.npy.tmp"
+    live_pid = tmp_path / f"dictrun-{os.getpid()}-11223344-0.bin"
+    own_token = tmp_path / f"accrun-{dead_pid}-99999999-0.npy"
+    unrelated = tmp_path / "not-a-run.bin"
+    for p in (orphan, orphan_tmp, live_pid, own_token, unrelated):
+        p.write_bytes(b"x")
+    old = time.time() - 3600
+    for p in (orphan, orphan_tmp, live_pid, own_token):
+        os.utime(p, (old, old))
+    # A foreign HOST's file (host tag != ours): pid liveness is
+    # unknowable across a shared filesystem — never touched, however old.
+    foreign = tmp_path / f"dictrun-hdeadbeef-{dead_pid}-aabbccdd-0.bin"
+    foreign.write_bytes(b"x")
+    os.utime(foreign, (old, old))
+    # Our own host tag + dead pid + old: scavenged like the legacy name.
+    tagged = tmp_path / (
+        f"dictrun-{spill.host_tag()}-{dead_pid}-aabbccdd-3.bin"
+    )
+    tagged.write_bytes(b"x")
+    os.utime(tagged, (old, old))
+    removed = spill.scavenge_stale_runs(d, live_tokens={"99999999"},
+                                        min_age_s=60)
+    assert sorted(removed) == sorted(
+        [orphan.name, orphan_tmp.name, tagged.name]
+    )
+    assert live_pid.exists() and own_token.exists() and unrelated.exists()
+    assert foreign.exists()
+    # Fresh files survive even with a dead writer (pid-recycle backstop).
+    fresh = tmp_path / f"dictrun-{dead_pid}-aabbccdd-2.bin"
+    fresh.write_bytes(b"x")
+    assert spill.scavenge_stale_runs(d, live_tokens={"99999999"},
+                                     min_age_s=60) == []
+    assert fresh.exists()
+
+
+def test_sigkilled_job_runs_are_scavenged(tmp_path):
+    # A real SIGKILL mid-spill: the child flushes runs then kills itself;
+    # its files survive the kill (that is the leak) and the next job's
+    # startup scavenge reclaims them.
+    script = (
+        "import os, signal\n"
+        "from mapreduce_rust_tpu.runtime.dictionary import Dictionary\n"
+        f"d = Dictionary(budget_words=8, spill_dir={str(tmp_path)!r})\n"
+        "d.add_words([('w%03d' % i).encode() for i in range(64)])\n"
+        "d.drain_spills()\n"
+        "os.kill(os.getpid(), signal.SIGKILL)\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", script], cwd=REPO, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == -signal.SIGKILL
+    leaked = glob.glob(str(tmp_path / "dictrun-*"))
+    assert leaked  # the SIGKILL leak this satellite exists for
+    old = time.time() - 3600
+    for p in leaked:
+        os.utime(p, (old, old))
+    removed = spill.scavenge_stale_runs(str(tmp_path))
+    assert sorted(removed) == sorted(os.path.basename(p) for p in leaked)
+    assert not glob.glob(str(tmp_path / "dictrun-*"))
+
+
+# ---------------------------------------------------------------------------
+# slow_disk chaos site
+# ---------------------------------------------------------------------------
+
+def test_slow_disk_spec_parses_and_targets_runs():
+    from mapreduce_rust_tpu.analysis.chaos import ChaosPlan
+
+    plan = ChaosPlan.parse("seed=6;slow_disk:0.5")
+    f = plan.pick("slow_disk", tid=3)
+    assert f is not None and f.seconds == 0.5
+    assert plan.pick("pause", phase="map", tid=0, attempt=1) is None
+    # p= samples runs by seeded hash of the run index: deterministic.
+    plan2 = ChaosPlan.parse("seed=6;slow_disk:0.5:p=0.5")
+    picks = [plan2.pick("slow_disk", tid=i) is not None for i in range(32)]
+    plan3 = ChaosPlan.parse("seed=6;slow_disk:0.5:p=0.5")
+    assert picks == [
+        plan3.pick("slow_disk", tid=i) is not None for i in range(32)
+    ]
+    assert any(picks) and not all(picks)
+    with pytest.raises(ValueError, match="slow_disk needs SECONDS"):
+        ChaosPlan.parse("slow_disk:map:0")
+
+
+def test_slow_disk_fires_in_spill_writes_outputs_exact(tmp_path, monkeypatch):
+    # The fault fires at the single spill-write checkpoint (both tiers ride
+    # it) and the delayed run is byte-identical to the undelayed one.
+    spec = "seed=6;slow_disk:0.01"
+    monkeypatch.setenv("MR_CHAOS", spec)
+    paths = _write_inputs(tmp_path)
+    cfg = _cfg(tmp_path, "chaos", dictionary_budget_words=256,
+               host_accum_budget_mb=1)
+    res = run_job(cfg, paths)
+    assert res.stats.dict_spill_runs > 0
+    fired = spill.chaos_fired(spec)
+    assert len(fired) >= res.stats.dict_spill_runs
+    monkeypatch.delenv("MR_CHAOS")
+    plain = _cfg(tmp_path, "plain", dictionary_budget_words=256,
+                 host_accum_budget_mb=1)
+    run_job(plain, paths)
+    assert _outputs(cfg) == _outputs(plain)
+
+
+# ---------------------------------------------------------------------------
+# Accumulator async tier
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("async_spill", [True, False])
+def test_accumulator_async_runs_fold_exactly(tmp_path, async_spill):
+    rng = np.random.default_rng(3)
+    plain = HostAccumulator("sum")
+    tiered = HostAccumulator("sum", budget_bytes=1 << 10,
+                             spill_dir=str(tmp_path),
+                             async_spill=async_spill)
+    for _ in range(50):
+        keys = rng.integers(0, 200, size=(100, 2))
+        vals = rng.integers(1, 5, size=100)
+        plain.add(keys, vals)
+        tiered.add(keys.copy(), vals.copy())
+    assert tiered.has_runs
+    assert tiered.table == plain.table  # table drains the writer first
+    st = tiered.spill_stats()
+    assert st["runs"] > 0 and st["bytes"] > 0
+    tiered.remove_runs()
+    assert not glob.glob(str(tmp_path / "accrun-*"))
