@@ -151,7 +151,15 @@ def _zipf_cfg(work: str, out: str, reduce_n: int):
     # --sweep-spill-budget rides into the leg as BENCH_SPILL_BUDGET_WORDS
     # (smaller budget = more, smaller runs = more spill-plane pressure).
     budget = int(os.environ.get("BENCH_SPILL_BUDGET_WORDS") or (1 << 19))
+    # Dispatch-plane knobs (ISSUE 13): --sweep-dispatch-fill rides in as
+    # BENCH_DISPATCH_FILL; the A/B pair turns coalescing off with
+    # BENCH_DISPATCH_COALESCE=0 (MR_DISPATCH_SYNC needs no plumbing — the
+    # driver reads the env directly, like MR_SPILL_SYNC).
+    fill = float(os.environ.get("BENCH_DISPATCH_FILL") or 0.5)
+    coalesce = os.environ.get("BENCH_DISPATCH_COALESCE", "1") != "0"
     return Config(
+        dispatch_fill_frac=fill,
+        dispatch_coalesce=coalesce,
         map_engine=os.environ.get("BENCH_MAP_ENGINE", "host"),
         host_map_workers=_env_host_workers(),
         fold_shards=_env_fold_shards(),
@@ -298,6 +306,14 @@ def zipf_leg(target_mb: int) -> None:
             "merge_fanin": s.merge_fanin,
             "budget_words": cfg.dictionary_budget_words,
             "bottleneck": s.bottleneck,
+            # Dispatch-plane attribution (ISSUE 13): the before/after
+            # story of the async coalescing plane lives in THESE fields'
+            # history rows.
+            "dispatch_mode": s.dispatch_mode,
+            "dispatch_s": round(s.dispatch_s, 3),
+            "dispatch_stall_s": round(s.dispatch_stall_s, 3),
+            "merge_dispatches": s.merge_dispatches,
+            "merge_fill_frac": round(s.merge_fill_frac, 4),
         }
     }))
     if not exact:
@@ -933,17 +949,23 @@ def _load_leg_manifest(path, t_start: float, pid: int):
     return None
 
 
-def _parse_sweep_counts(spec: str, flag: str) -> list:
+def _parse_sweep_counts(spec: str, flag: str, typ=int) -> list:
+    """Comma-separated sweep points. ``typ=float`` for fraction sweeps
+    (--sweep-dispatch-fill) — those must land in (0, 1]; integer sweeps
+    stay >= 1."""
     counts = []
     for tok in spec.split(","):
         tok = tok.strip()
         if tok:
-            n = int(tok)
-            if n < 1:
+            n = typ(tok)
+            if (typ is int and n < 1) or (typ is float and not 0 < n <= 1):
                 raise SystemExit(f"{flag}: bad count {n}")
             counts.append(n)
     if not counts:
-        raise SystemExit(f"{flag} needs counts, e.g. 1,2,4")
+        raise SystemExit(
+            f"{flag} needs counts, e.g. "
+            + ("0.25,0.5,0.9" if typ is float else "1,2,4")
+        )
     return counts
 
 
@@ -1096,6 +1118,242 @@ def sweep_spill_budget(spec: str) -> None:
         timeout_s=int(os.environ.get("BENCH_ZIPF_TIMEOUT_S", "420")),
         corpus_label=f"{zipf_mb}MB zipf corpus",
     )
+
+
+def sweep_dispatch_fill(spec: str) -> None:
+    """`--sweep-dispatch-fill 0.25,0.5,0.9` (ISSUE 13 satellite): the
+    dispatch-plane coalescing curve — the ZIPF leg (budgets engaged,
+    exactness vs generator ground truth) once per dispatch_fill_frac, the
+    fraction riding in as BENCH_DISPATCH_FILL. Lower fill = more, emptier
+    merges (less combine latency per dispatch); higher = fewer, fuller
+    device hops. The per-point dispatch_split says where the knee is on
+    this host."""
+    zipf_mb = int(os.environ.get("BENCH_ZIPF_MB", "256"))
+
+    def point_stats(s: dict) -> dict:
+        split = s.get("dispatch_split") or {}
+        return {
+            "bottleneck": s.get("bottleneck"),
+            "wall_s": s.get("wall_seconds"),
+            "dispatch_s": split.get("dispatch_s"),
+            "dispatch_stall_s": split.get("stall_s"),
+            "merge_dispatches": split.get("dispatches"),
+            "merge_fill_frac": split.get("fill_frac"),
+        }
+
+    _run_sweep(
+        _parse_sweep_counts(spec, "--sweep-dispatch-fill", typ=float),
+        "BENCH_DISPATCH_FILL", "f", "dispatch_fill_frac",
+        "dispatch fill threshold (zipf leg)", "sweep_dispatch_fill",
+        point_stats, mode="--zipf",
+        corpus=pathlib.Path(str(zipf_mb)),
+        manifest_env="BENCH_ZIPF_RUN_MANIFEST",
+        gbs_of=lambda res: (res.get("zipf") or {}).get("gbs"),
+        timeout_s=int(os.environ.get("BENCH_ZIPF_TIMEOUT_S", "420")),
+        corpus_label=f"{zipf_mb}MB zipf corpus",
+    )
+
+
+def dispatch_ab_pair() -> None:
+    """`--dispatch-ab` (ISSUE 13 acceptance): the Zipf spill leg with the
+    FULL dispatch plane (async + cross-window coalescing) vs the PR 10
+    path (sync inline dispatch, no coalescing), INTERLEAVED min-of-3 per
+    side so machine drift hits both sides equally. One JSON line + one
+    history row carrying both walls and the speedup — the end-to-end
+    number the host-glue ROADMAP item is struck with. Exactness is
+    enforced inside every leg (exit 3 on a ground-truth mismatch fails
+    the pair loudly)."""
+    zipf_mb = int(os.environ.get("BENCH_ZIPF_MB", "256"))
+    repeats = int(os.environ.get("BENCH_DISPATCH_AB_REPEATS", "3"))
+    timeout = int(os.environ.get("BENCH_ZIPF_TIMEOUT_S", "420"))
+    sides: dict = {"plane": [], "pr10": []}
+    errors: list[str] = []
+    for r in range(repeats):
+        for side in ("plane", "pr10"):  # interleaved: drift hits both
+            env = _cpu_env()
+            if side == "pr10":
+                env["MR_DISPATCH_SYNC"] = "1"
+                env["BENCH_DISPATCH_COALESCE"] = "0"
+            res, err = _run_device_leg(
+                pathlib.Path(str(zipf_mb)), timeout, env,
+                init_timeout_s=PROBE_TIMEOUT_S, mode="--zipf",
+            )
+            if res is None:
+                errors.append(f"{side}[{r}]: {err}")
+                continue
+            sides[side].append(res.get("zipf") or {})
+            print(f"dispatch-ab {side}[{r}]: "
+                  f"wall={sides[side][-1].get('wall_s')}s",
+                  file=sys.stderr)
+
+    def best(rows: list) -> dict | None:
+        rows = [r for r in rows if r.get("wall_s")]
+        return min(rows, key=lambda r: r["wall_s"]) if rows else None
+
+    a, b = best(sides["plane"]), best(sides["pr10"])
+    speedup = (
+        round(b["wall_s"] / a["wall_s"], 3) if a and b else None
+    )
+    pick = lambda r: None if r is None else {  # noqa: E731
+        k: r.get(k) for k in (
+            "wall_s", "gbs", "bottleneck", "dispatch_mode", "dispatch_s",
+            "dispatch_stall_s", "merge_dispatches", "merge_fill_frac",
+            "spill_stall_s",
+        )
+    }
+    result = {
+        "metric": f"zipf dispatch-plane A/B ({zipf_mb}MB, async+coalesce "
+                  f"vs sync uncoalesced, interleaved min-of-{repeats})",
+        "unit": "x",
+        "value": speedup,
+        "plane": pick(a),
+        "pr10": pick(b),
+        "platform": "cpu",
+    }
+    if errors:
+        result["error"] = "; ".join(errors)
+    _append_history({
+        "metric": result["metric"],
+        "value": speedup,
+        "unit": "x",
+        "platform": "cpu",
+        "zipf_wall_s": (a or {}).get("wall_s"),
+        "zipf_gbs": (a or {}).get("gbs"),
+        "merge_dispatches": (a or {}).get("merge_dispatches"),
+        "merge_fill_frac": (a or {}).get("merge_fill_frac"),
+        "dispatch_mode": (a or {}).get("dispatch_mode"),
+        "dispatch_ab": {"plane": pick(a), "pr10": pick(b)},
+        "had_errors": bool(errors),
+    })
+    print(json.dumps(result))
+    if a is None or b is None:
+        raise SystemExit(1)
+
+
+def slow_dispatch_leg(path: str) -> None:
+    """Runs in a subprocess (--slow-dispatch-leg): the ISSUE 13 chaos
+    pair — the SAME word-count job under a seeded per-merge-dispatch
+    delay (`slow_dispatch`), async dispatch plane vs the inline sync
+    path. The async side overlaps the delayed device hops with the scans
+    feeding it (stall only when the depth-bounded queue fills); the sync
+    side eats every delay on the router's wall. Outputs must stay
+    bit-identical — the overlap is a scheduling change, never a data
+    change."""
+    import jax
+
+    platform = jax.devices()[0].platform
+    print(f"BENCH_DEVICE_READY {platform}", file=sys.stderr, flush=True)
+
+    import dataclasses
+    import shutil
+
+    from mapreduce_rust_tpu.config import Config
+    from mapreduce_rust_tpu.runtime.driver import (
+        dispatch_chaos_fired,
+        enable_compilation_cache,
+        run_job,
+    )
+
+    enable_compilation_cache("auto")
+    # Seeded p= sampling keeps the TOTAL injected delay below the
+    # router-side pipeline's capacity to hide it — a delay on every
+    # dispatch would just serialize both sides behind the sleep and the
+    # pair would measure nothing but the injection. High-cardinality
+    # corpus: the router's dictionary fold is the real work the async
+    # plane overlaps the delayed hops with (the gut corpus's tiny
+    # vocabulary leaves the router nearly idle, and a 2-core box then
+    # shows no difference to hide).
+    spec = os.environ.get("BENCH_SLOW_DISPATCH_SPEC",
+                          "seed=7;slow_dispatch:0.01")
+    # Rate-matched injection: a small delay on EVERY dispatch (the
+    # per-window router interval is ~25 ms here) pipelines through the
+    # depth-bounded queue, so the async side hides nearly the whole
+    # injected total behind the router's fold — measured 1.7 s hidden on
+    # this image at 48 MB. Few-but-large delays DON'T demonstrate this
+    # (the bounded queue caps run-ahead per sleep episode).
+    corpus, _counts = build_zipf_corpus(
+        int(os.environ.get("BENCH_SLOW_DISPATCH_MB", "48"))
+    )
+    path = str(corpus)
+    root = BENCH_DIR / "slow-dispatch"
+    base = Config(
+        map_engine="host",
+        # Small windows: many dispatches (one per window uncoalesced), so
+        # the seeded delay fires a steady stream the async plane must
+        # hide. Coalescing stays ON — the delay fires per DISPATCH, and
+        # both sides coalesce identically, so the pair isolates the
+        # overlap, not the coalesce factor.
+        # Small windows + an engaged dictionary budget: the router has
+        # real per-window work of its own (fold + flush freezes) for the
+        # async plane to overlap the delayed hops WITH — the hidden_s
+        # margin is the router-side pipeline, so give it one.
+        host_window_bytes=256 << 10,
+        chunk_bytes=1 << 20,
+        merge_capacity=1 << 14,          # constant device eviction = compute
+        host_update_cap=1 << 13,         # small cap: the staging buffer
+        # crosses its fill threshold once or more per window, so the
+        # seeded delay fires a dispatch-rate stream on both sides
+        dictionary_budget_words=4096,    # router-side fold + flush churn
+        host_accum_budget_mb=64,
+        reduce_n=4,
+        device="auto",
+        work_dir=str(root / "work"),
+        output_dir=str(root / "out"),
+    )
+    # Chaos-free warmup compiles every step shape so neither measured side
+    # pays XLA time (the persistent cache makes this cheap when warm).
+    shutil.rmtree(root, ignore_errors=True)
+    warm = BENCH_DIR / "warmup-slowdispatch.txt"
+    with open(path, "rb") as f:
+        warm.write_bytes(f.read(base.host_window_bytes + 4096))
+    run_job(dataclasses.replace(
+        base, work_dir=str(root / "warm-work"),
+        output_dir=str(root / "warm-out"),
+        # Budgets off: warmup exists for the XLA compiles only, and a
+        # budgeted run demands write_outputs (streaming egress).
+        dictionary_budget_words=None, host_accum_budget_mb=None,
+    ), [str(warm)], write_outputs=False)
+
+    os.environ["MR_CHAOS"] = spec
+    sides: dict = {}
+    outputs: dict = {}
+    for side, async_dispatch in (("async", True), ("sync", False)):
+        cfg = dataclasses.replace(
+            base, dispatch_async=async_dispatch,
+            work_dir=str(root / f"work-{side}"),
+            output_dir=str(root / f"out-{side}"),
+        )
+        t0 = time.perf_counter()
+        res = run_job(cfg, [str(path)])
+        wall = time.perf_counter() - t0
+        s = res.stats
+        sides[side] = {
+            "wall_s": round(wall, 3),
+            "dispatch_s": round(s.dispatch_s, 3),
+            "dispatch_stall_s": round(s.dispatch_stall_s, 3),
+            "merge_dispatches": s.merge_dispatches,
+            "glue_s": round(s.host_glue_s, 3),
+        }
+        outputs[side] = {
+            p.name: p.read_bytes()
+            for p in sorted(pathlib.Path(cfg.output_dir).glob("mr-*.txt"))
+        }
+    fired = len(dispatch_chaos_fired(spec))
+    identical = bool(outputs["async"]) and outputs["async"] == outputs["sync"]
+    hidden = round(sides["sync"]["wall_s"] - sides["async"]["wall_s"], 3)
+    print(json.dumps({
+        "slow_dispatch": {
+            "platform": platform,
+            "spec": spec,
+            "fired": fired,
+            "async": sides["async"],
+            "sync": sides["sync"],
+            "hidden_s": hidden,
+            "outputs_identical": identical,
+        }
+    }))
+    if not identical or fired == 0:
+        raise SystemExit(3)
 
 
 def slow_disk_leg(path: str) -> None:
@@ -1417,6 +1675,45 @@ def chaos_legs() -> None:
     except Exception as e:
         ok = False
         slow_disk = {"error": repr(e)}
+    # Slow-dispatch pair (ISSUE 13 satellite): the seeded per-merge-
+    # dispatch delay against a real window stream, async dispatch plane
+    # vs the inline sync path — the proof the plane HIDES the device hop
+    # needs its own leg exactly like slow_disk's. Exit 3 in the leg =
+    # outputs diverged or the fault never fired; either fails here.
+    slow_dispatch = None
+    try:
+        # The leg builds (and caches) its own high-cardinality zipf
+        # corpus — the argument is unused (kept for the shared runner's
+        # argv shape).
+        sd2_res, sd2_err = _run_device_leg(
+            pathlib.Path("zipf-slow-dispatch"),
+            int(os.environ.get("BENCH_SLOW_DISPATCH_TIMEOUT_S", "300")),
+            _cpu_env(), init_timeout_s=PROBE_TIMEOUT_S,
+            mode="--slow-dispatch-leg",
+        )
+        if sd2_res is None:
+            ok = False
+            slow_dispatch = {"error": sd2_err}
+        else:
+            slow_dispatch = sd2_res.get("slow_dispatch")
+            hidden = (slow_dispatch or {}).get("hidden_s")
+            if not (slow_dispatch or {}).get("outputs_identical") \
+                    or hidden is None or hidden <= 0:
+                ok = False  # the dispatch thread must measurably hide the
+                # injected delay the sync path eats on its wall
+        print(f"chaos slow_dispatch pair: {json.dumps(slow_dispatch)}",
+              file=sys.stderr)
+        _append_history({
+            "metric": "chaos slow_dispatch: async-vs-sync dispatch pair",
+            "value": None,  # chaos rows stay out of the trend series
+            "unit": "s",
+            "platform": "cpu",
+            "chaos_scenario": "slow_dispatch-pair",
+            "chaos_slow_dispatch": slow_dispatch,
+        })
+    except Exception as e:
+        ok = False
+        slow_dispatch = {"error": repr(e)}
     nospec = next((r for r in rows if r["scenario"] == "slow_scan-nospec"), None)
     spec = next((r for r in rows if r["scenario"] == "slow_scan-spec"), None)
     result = {
@@ -1427,6 +1724,7 @@ def chaos_legs() -> None:
         "baseline_wall_s": baseline_wall,
         "scenarios": rows,
         "slow_disk_pair": slow_disk,
+        "slow_dispatch_pair": slow_dispatch,
         "speculation_speedup": (
             round(nospec["wall_s"] / spec["wall_s"], 2)
             if nospec and spec and nospec.get("wall_s") and spec.get("wall_s")
@@ -1677,6 +1975,13 @@ def _append_history(result: dict) -> None:
             "zipf_spill_stall_s": (result.get("zipf") or {}).get("spill_stall_s"),
             "zipf_spill_write_s": (result.get("zipf") or {}).get("spill_write_s"),
             "spill_run_format": (result.get("zipf") or {}).get("spill_format"),
+            # Dispatch-plane trajectory (ISSUE 13): dispatch count + mean
+            # fill per row; merge_fill_frac is trend-watched (bad = down —
+            # emptier dispatches mean the coalesce factor is eroding).
+            "merge_dispatches": (result.get("zipf") or {}).get("merge_dispatches"),
+            "merge_fill_frac": (result.get("zipf") or {}).get("merge_fill_frac"),
+            "dispatch_mode": (result.get("zipf") or {}).get("dispatch_mode"),
+            "zipf_dispatch_stall_s": (result.get("zipf") or {}).get("dispatch_stall_s"),
             # Sampler tax (ISSUE 8): a watched trend series (bad
             # direction: up) — None on chaos/sweep rows keeps it clean.
             "metrics_overhead_frac": (
@@ -1846,10 +2151,16 @@ if __name__ == "__main__":
         # the env var rides into both inherited and cpu_only_env child
         # environments like MR_SANITIZE.
         os.environ["MR_SPILL_SYNC"] = "1"
+    if _take_switch(_argv, "--sync-dispatch"):
+        # Inline (router-thread) merge dispatch on every leg — the PR 10
+        # path, same enablement pattern as --sync-spill.
+        os.environ["MR_DISPATCH_SYNC"] = "1"
     _chaos = _take_switch(_argv, "--chaos")
     _sweep = _take_flag(_argv, "--sweep-host-workers")
     _sweep_fold = _take_flag(_argv, "--sweep-fold-shards")
     _sweep_spill = _take_flag(_argv, "--sweep-spill-budget")
+    _sweep_fill = _take_flag(_argv, "--sweep-dispatch-fill")
+    _dispatch_ab = _take_switch(_argv, "--dispatch-ab")
     sys.argv = [sys.argv[0]] + _argv
     if _chaos:
         try:
@@ -1893,6 +2204,28 @@ if __name__ == "__main__":
                 "error": f"sweep harness: {e!r}",
             }))
             raise SystemExit(1)
+    elif _sweep_fill:
+        try:
+            sweep_dispatch_fill(_sweep_fill)
+        except BaseException as e:  # one JSON line, like the main harness
+            print(json.dumps({
+                "metric": "zipf GB/s vs dispatch fill threshold",
+                "unit": "GB/s", "sweep": None,
+                "error": f"sweep harness: {e!r}",
+            }))
+            raise SystemExit(1)
+    elif _dispatch_ab:
+        try:
+            dispatch_ab_pair()
+        except SystemExit:
+            raise
+        except BaseException as e:  # one JSON line, like the main harness
+            print(json.dumps({
+                "metric": "zipf dispatch-plane A/B",
+                "unit": "x", "value": None,
+                "error": f"dispatch-ab harness: {e!r}",
+            }))
+            raise SystemExit(1)
     elif len(sys.argv) > 1 and sys.argv[1] == "--device-leg":
         device_leg(sys.argv[2])
     elif len(sys.argv) > 1 and sys.argv[1] == "--micro":
@@ -1905,6 +2238,8 @@ if __name__ == "__main__":
         zipf_ii_leg(int(sys.argv[2]))
     elif len(sys.argv) > 1 and sys.argv[1] == "--slow-disk-leg":
         slow_disk_leg(sys.argv[2])
+    elif len(sys.argv) > 1 and sys.argv[1] == "--slow-dispatch-leg":
+        slow_dispatch_leg(sys.argv[2])
     else:
         try:
             main()

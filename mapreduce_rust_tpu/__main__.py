@@ -134,6 +134,11 @@ def _cfg(args, map_n: int = 1, worker_n: int = 1) -> Config:
         host_accum_budget_mb=getattr(args, "accum_budget_mb", None),
         dictionary_budget_words=getattr(args, "dict_budget_words", None),
         spill_async=not getattr(args, "sync_spill", False),
+        dispatch_async=not getattr(args, "sync_dispatch", False),
+        dispatch_coalesce=not getattr(args, "no_dispatch_coalesce", False),
+        # No `or 0.5` fallback: an explicit invalid 0 must hit Config's
+        # validation error, not be silently remapped to the default.
+        dispatch_fill_frac=getattr(args, "dispatch_fill", 0.5),
         profile_dir=args.profile_dir,
         trace_path=getattr(args, "trace", None),
         manifest_path=getattr(args, "manifest", None),
@@ -530,6 +535,27 @@ def main(argv: list[str] | None = None) -> int:
                         "thread instead of the async background writer "
                         "(debugging / A-B measurement; outputs identical; "
                         "MR_SPILL_SYNC=1 does the same for a process tree)")
+    p.add_argument("--sync-dispatch", action="store_true",
+                   dest="sync_dispatch",
+                   help="host engine: run scatter/pack/device_put and the "
+                        "compiled merge inline on the router thread instead "
+                        "of the async dispatch plane (debugging / A-B "
+                        "measurement; outputs identical at a fixed coalesce "
+                        "setting; MR_DISPATCH_SYNC=1 does the same for a "
+                        "process tree)")
+    p.add_argument("--no-dispatch-coalesce", action="store_true",
+                   dest="no_dispatch_coalesce",
+                   help="host engine: disable cross-window update "
+                        "coalescing — every window dispatches its own "
+                        "packed merges, the PR 10 stream (oracle-exact "
+                        "either way; sum-op apps only ever coalesce)")
+    p.add_argument("--dispatch-fill", type=float, default=0.5,
+                   dest="dispatch_fill",
+                   help="host engine: staging fill fraction of the staging "
+                        "combine buffer (dispatch_stage_cap, auto 64x the "
+                        "update cap) that triggers a coalesced merge "
+                        "dispatch (default 0.5; higher = more cross-window "
+                        "dedup per record shipped)")
     p.add_argument("--distributed", action="store_true",
                    help="join a multi-host jax.distributed cluster before "
                    "building the mesh; the all_to_all shuffle then rides "

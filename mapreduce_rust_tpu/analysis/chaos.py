@@ -44,6 +44,16 @@ an int or ``*``; SECONDS a float)::
                                  while the sync plane stalls per run —
                                  bench.py --chaos measures exactly that
                                  pair (ISSUE 11)
+    slow_dispatch:SECONDS        every device-merge dispatch sleeps
+                                 SECONDS first (runtime/driver.py — ONE
+                                 checkpoint in the dispatch plane, firing
+                                 per packed merge; ``p=`` samples by
+                                 seeded hash of the dispatch index). The
+                                 slow-device-hop straggler the ASYNC
+                                 dispatch plane hides on its own thread
+                                 while --sync-dispatch eats every delay
+                                 on the router's wall — bench.py --chaos
+                                 measures exactly that pair (ISSUE 13)
 
 Trailing ``KEY=VAL`` args refine any fault: ``attempt=N`` (default 1 —
 a fault that re-fired on the recovery attempt would loop forever; ``*``
@@ -62,9 +72,10 @@ import os
 
 SITES = (
     "pause", "kill", "drop_finish", "delay_finish", "wedge_renewal",
-    "slow_scan", "slow_disk",
+    "slow_scan", "slow_disk", "slow_dispatch",
 )
-_NEEDS_SECONDS = ("pause", "delay_finish", "slow_scan", "slow_disk")
+_NEEDS_SECONDS = ("pause", "delay_finish", "slow_scan", "slow_disk",
+                  "slow_dispatch")
 
 #: Canonical scenario specs shared by ``bench.py --chaos`` and the chaos
 #: test suite — one copy, so the benched and the tested faults are the
@@ -84,6 +95,11 @@ SCENARIOS: dict[str, str] = {
     # dedicated --chaos slow-disk pair runs it against a BUDGETED job,
     # async vs sync, to measure what the background writer hides.
     "slow_disk": "seed=6;slow_disk:0.05",
+    # Fires on every packed device merge the host engine dispatches
+    # (cluster workers run map_engine='host'); the bench's dedicated
+    # --chaos slow-dispatch pair runs it async-vs-sync against a real
+    # window stream to measure what the dispatch thread hides (ISSUE 13).
+    "slow_dispatch": "seed=7;slow_dispatch:0.02",
 }
 
 
@@ -183,11 +199,12 @@ class ChaosPlan:
                 f.wid = int(pos[0][1:])
                 f.seconds = float(pos[1])
                 f.attempt = None  # a slow worker is slow on EVERY attempt
-            elif site == "slow_disk":
+            elif site in ("slow_disk", "slow_dispatch"):
                 if len(pos) != 1:
-                    raise bad("slow_disk needs SECONDS")
+                    raise bad(f"{site} needs SECONDS")
                 f.seconds = float(pos[0])
-                f.attempt = None  # a slow disk is slow on EVERY run write
+                f.attempt = None  # a slow disk/device hop is slow on
+                # EVERY run write / merge dispatch
             else:
                 want = 3 if site in _NEEDS_SECONDS else 2
                 if len(pos) != want:

@@ -57,6 +57,7 @@ WATCHED_METRICS: dict = {
     "stats.host_glue_s": ("up", 0.50),
     "stats.fold_stall_s": ("up", 0.50),
     "stats.spill_stall_s": ("up", 0.50),
+    "stats.dispatch_stall_s": ("up", 0.50),
     "stats.scan_wait_s": ("up", 0.50),
     "stats.all_to_all_s": ("up", 0.50),
     "stats.compile.total_s": ("up", 1.00),
@@ -67,6 +68,7 @@ WATCHED_METRICS: dict = {
     "stats.histograms.host_map.glue_s.p95": ("up", 0.50),
     "stats.histograms.host_map.fold_s.p95": ("up", 0.50),
     "stats.histograms.spill.write_s.p95": ("up", 0.50),
+    "stats.histograms.dispatch.submit_s.p95": ("up", 0.50),
     "stats.histograms.a2a.round_s.p95": ("up", 0.50),
     "stats.histograms.device.drain_s.p95": ("up", 0.50),
 }
@@ -166,6 +168,17 @@ def _bottleneck_attribution(stats: dict) -> dict:
     # engagement test.
     if (stats.get("spill_s") or 0) > 0 or (stats.get("spill_stall_s") or 0) > 0:
         legacy["spill"] = stats.get("spill_stall_s", 0.0) or 0.0
+    # Async dispatch plane (ISSUE 13): the device hop runs off the router,
+    # so "the dispatch is the ceiling" reads as router backpressure —
+    # mirrors JobStats.bottleneck's arm exactly. Sync mode keeps the hop
+    # in glue (the PR 10 attribution), so the arm stays off there. Live
+    # fleet aggregates carry no dispatch_mode — the mere presence of
+    # dispatch stall arms the component, the fold/spill pattern.
+    mode = stats.get("dispatch_mode")
+    if (isinstance(mode, str) and mode.startswith("async")) or (
+        mode is None and (stats.get("dispatch_stall_s") or 0) > 0
+    ):
+        legacy["merge-dispatch"] = stats.get("dispatch_stall_s", 0.0) or 0.0
     name, val = max(legacy.items(), key=lambda kv: kv[1])
     primary = name if val > 0 else "balanced"
     extended = dict(legacy)
@@ -269,6 +282,34 @@ def diagnose(manifest: dict, job_report: "dict | None" = None,
                  + (f" [{sp.get('bytes', 0) / 1e6:.0f} MB over "
                     f"{sp.get('dict_runs', 0)}+{sp.get('accum_runs', 0)} "
                     "runs]" if sp else ""))
+        if bn["name"] == "merge-dispatch":
+            dp = stats.get("dispatch_split") or {}
+            find("warn", "merge-dispatch-bound",
+                 f"dispatch backpressure ({stats.get('dispatch_stall_s', 0):.3f}s "
+                 "blocked on the full dispatch queue) exceeds every other "
+                 "wait component — the per-merge device hop is the "
+                 "ceiling: raise dispatch_fill_frac (more cross-window "
+                 "coalescing per dispatch), raise host_update_cap (fewer, "
+                 "fatter merges), or check the device link"
+                 + (f" [{dp.get('dispatches', 0)} dispatches at mean fill "
+                    f"{dp.get('fill_frac', 0):.2f}]" if dp else ""))
+        dp = stats.get("dispatch_split") or {}
+        if (
+            dp.get("dispatches", 0) >= 8
+            and (dp.get("fill_frac") or 0) < 0.10
+            and (dp.get("dispatch_s") or 0) > 0.2
+        ):
+            # Raise-cap-vs-threshold guidance (ISSUE 13): mostly-empty
+            # fixed-shape updates mean the 1+3·cap transfer is sentinel
+            # padding and the per-dispatch fixed cost dominates.
+            find("info", "dispatch-low-fill",
+                 f"merge dispatches ran {dp.get('fill_frac', 0):.0%} full "
+                 f"on average over {dp.get('dispatches')} dispatches — the "
+                 "fixed-shape update is mostly sentinel padding: raise "
+                 "dispatch_fill_frac (coalesce more windows per dispatch) "
+                 "if latency allows, or lower host_update_cap so the "
+                 "compiled merge shape matches the real update size "
+                 "(one-time recompile, smaller transfers thereafter)")
         wall = stats.get("wall_seconds") or 0.0
         comp = stats.get("compile") or {}
         if comp and wall and comp.get("total_s", 0.0) > 0.5 * wall:
@@ -522,7 +563,8 @@ _POST_MORTEM_CODES = frozenset({
 #: strip to the JobStats field name).
 _WAIT_FIELDS = ("ingest_wait_s", "device_wait_s", "host_map_s",
                 "host_glue_s", "fold_s", "fold_stall_s", "spill_s",
-                "spill_stall_s", "scan_wait_s", "all_to_all_s")
+                "spill_stall_s", "dispatch_s", "dispatch_stall_s",
+                "scan_wait_s", "all_to_all_s")
 
 
 def diagnose_live(stats_rpc: dict, lease_timeout_s: "float | None" = None,
@@ -692,6 +734,11 @@ TREND_SERIES: dict[str, str] = {
     # metrics-off pair each run; a creeping overhead fraction is exactly
     # the slow-boil regression class trend exists for.
     "metrics_overhead_frac": "up",
+    # Dispatch-plane coalescing effectiveness (ISSUE 13): mean update
+    # fill drifting DOWN means dispatches go out emptier round over round
+    # — the coalesce factor eroding (a vocabulary shift, a threshold
+    # regression) long before the wall number moves.
+    "merge_fill_frac": "down",
 }
 
 

@@ -1333,8 +1333,12 @@ class BlockingIoInFoldRule(ProgramRule):
     _FILE_METHODS = ("write", "flush", "writelines")
     #: Frames whose presence in the chain sanctions the I/O below them:
     #: the flight recorder / metrics sampler ticks are throttled by
-    #: contract (their own modules own that budget).
-    _EXEMPT_FRAMES = ("maybe_snapshot", "metrics_tick")
+    #: contract (their own modules own that budget), and a plane ``submit``
+    #: handoff (AsyncSpillWriter / _DispatchPlane) makes everything below
+    #: it the plane's business — its sync mode runs the same frames inline
+    #: as an explicit opt-in debug/measurement path, not a fold-thread
+    #: regression (the rule-14 doctrine, shared).
+    _EXEMPT_FRAMES = ("maybe_snapshot", "metrics_tick", "submit")
 
     def _io_call(self, call) -> "str | None":
         q = qualname(call.func)
@@ -1408,6 +1412,105 @@ class BlockingIoInFoldRule(ProgramRule):
                     )
 
 
+class DeviceDispatchInConsumerRule(ProgramRule):
+    """No device dispatch reachable from the consume/fold hot scopes
+    (rule 14).
+
+    The dispatch plane (ISSUE 13) exists because the host-map consumer
+    used to scatter, pack, ``jax.device_put`` and invoke the compiled
+    packed merge INLINE per window — ~13 s of the 24 s Zipf leg booked as
+    host-glue after PR 10 moved everything else off the router. The
+    invariant this rule pins (mirroring rule 13's spill contract): the
+    router-side hot scopes (the host-map consumer, the fold-plane thread
+    body, the dictionary fold mutators) hand windows to the dispatch
+    plane (``_DispatchPlane.submit`` — the sanctioned sink frame) and
+    never reach ``jax.device_put`` or a merge function produced by
+    ``make_packed_merge_fn`` themselves, directly or through sync helper
+    frames. Chains that pass the plane's ``submit`` are the plane's own
+    sync mode — sanctioned by design (that IS the A/B debug path);
+    throttled telemetry ticks stay exempt like rule 13.
+    """
+
+    name = "device-dispatch-in-consumer"
+    summary = "consume/fold hot scopes dispatch device work only via the plane"
+
+    #: Router-side hot scopes, by the runtime's naming (a rename there
+    #: must update this list — the fixtures gate the semantics).
+    _HOT = (
+        "consume", "_fold_one", "fold_scan_into_dictionary",
+        "add_scanned_raw", "add_scanned", "add_words", "_insert_hashed",
+        "route_raw", "route_list",
+    )
+    #: Device-hop producers: the transfer call by qualname, and any call
+    #: through a name that ORIGINATES from make_packed_merge_fn (reaching
+    #: defs — `merge_packed = make_packed_merge_fn(...); merge_packed(...)`).
+    _DEVICE_FUNCS = ("device_put",)
+    _MERGE_FACTORY = "make_packed_merge_fn"
+    #: Frames whose presence sanctions the dispatch below them: the
+    #: dispatch plane's submit handoff (its sync mode runs the same code
+    #: inline — that is the measurement plane, not a violation), plus the
+    #: throttled telemetry ticks rule 13 also exempts.
+    _EXEMPT_FRAMES = ("submit", "maybe_snapshot", "metrics_tick")
+
+    def _device_call(self, call, fu, defs_reach) -> "str | None":
+        q = qualname(call.func)
+        if q and _last_segment(q) in self._DEVICE_FUNCS:
+            return q
+        # A call THROUGH a packed-merge closure: receiver name originates
+        # from a make_packed_merge_fn(...) call via reaching definitions.
+        if isinstance(call.func, ast.Name):
+            from mapreduce_rust_tpu.analysis.dataflow import origins
+
+            defs, reach = defs_reach()
+            for o in origins(fu.cfg, defs, reach, call.func):
+                if (
+                    isinstance(o, ast.Call)
+                    and _last_segment(qualname(o.func)) == self._MERGE_FACTORY
+                ):
+                    return f"{self._MERGE_FACTORY}(...) result"
+        return None
+
+    def run_program(self, program):
+        seen: set[tuple[str, int]] = set()
+        for root in program.functions:
+            if root.name not in self._HOT:
+                continue
+            frames = [(root, [])] + program.reachable(root)
+            for fu, chain in frames:
+                if fu.name in self._EXEMPT_FRAMES or any(
+                    src.name in self._EXEMPT_FRAMES for src, _call in chain
+                ):
+                    continue
+                cache: list = []
+
+                def defs_reach(fu=fu, cache=cache):
+                    if not cache:
+                        cache.append(fu.rd)
+                    return cache[0]
+
+                for call, _target in program.callees(fu):
+                    hit = self._device_call(call, fu, defs_reach)
+                    if hit is None:
+                        continue
+                    key = (fu.path, getattr(call, "lineno", 0))
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    via = (
+                        f" via {_call_chain(chain)} -> {fu.qualname}"
+                        if chain else ""
+                    )
+                    yield self.finding(
+                        fu.path, call,
+                        f"{hit!r} reached from consume/fold hot scope "
+                        f"{root.qualname}{via} without going through the "
+                        "dispatch-plane submit handoff — an inline device "
+                        "hop on the router thread was the ~13s host-glue "
+                        "wall of the Zipf leg (ISSUE 13); hand the window "
+                        "to _DispatchPlane.submit instead",
+                    )
+
+
 ALL_RULES: list[Rule] = [
     StatsOwnershipRule(),
     ExecutorTeardownRule(),
@@ -1431,4 +1534,5 @@ PROGRAM_RULES: list[ProgramRule] = [
     NondeterministicPartitionRule(),
     CrossShardFoldRule(),
     BlockingIoInFoldRule(),
+    DeviceDispatchInConsumerRule(),
 ]

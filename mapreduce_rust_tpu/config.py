@@ -16,6 +16,17 @@ import os
 from typing import Optional
 
 
+def sync_dispatch_forced() -> bool:
+    """``MR_DISPATCH_SYNC`` — process-tree opt-out of the async dispatch
+    plane (the MR_SPILL_SYNC enablement pattern). Lives HERE, the one
+    module both the driver (plane construction) and the fold-shard auto
+    heuristic below read, so the two can never disagree on what counts
+    as enabled."""
+    return os.environ.get("MR_DISPATCH_SYNC", "").strip().lower() in (
+        "1", "true", "on", "yes"
+    )
+
+
 @dataclasses.dataclass
 class Config:
     # ---- Job shape (reference: argv of mrcoordinator/mrworker) ----
@@ -133,6 +144,63 @@ class Config:
                                     # above this many words, and finalize
                                     # switches to the streaming merge-join
                                     # egress. None = all-RAM.
+    # ---- Device-merge dispatch plane (ISSUE 13) ----
+    dispatch_async: bool = True     # host-map engine: scatter-back, pack,
+                                    # device_put and the compiled merge run
+                                    # on a dedicated depth-bounded dispatch
+                                    # thread — the router hands off O(1)
+                                    # per window and host-glue stops
+                                    # booking device hops. False (or
+                                    # MR_DISPATCH_SYNC=1 for a whole
+                                    # process tree) runs the dispatch
+                                    # inline on the router thread: the
+                                    # measurement/debug plane the bench's
+                                    # A/B pair runs. Outputs are
+                                    # bit-identical either way at a fixed
+                                    # coalesce setting.
+    dispatch_coalesce: bool = True  # cross-window coalescing: successive
+                                    # windows' (packed-key, count) results
+                                    # merge into a staging combine buffer
+                                    # (duplicate keys sum — the native
+                                    # mr_coalesce_updates kernel), and a
+                                    # device merge dispatches only when
+                                    # fill crosses dispatch_fill_frac or
+                                    # the stream ends. Zipf duplication
+                                    # across windows means far fewer
+                                    # records shipped. Engages only for
+                                    # combine_op == "sum" apps (pre-summing
+                                    # any other op would be wrong); outputs
+                                    # stay oracle-exact — the merge stream
+                                    # changes, the results cannot.
+    dispatch_fill_frac: float = 0.5  # staging fill fraction (of
+                                    # dispatch_stage_cap) that triggers a
+                                    # coalesced dispatch. Lower = smaller,
+                                    # more frequent merges (less host
+                                    # combine latency); higher = fewer,
+                                    # fuller merges (more cross-window
+                                    # dedup per record shipped). The
+                                    # doctor's merge-dispatch finding
+                                    # reads the measured mean fill.
+    dispatch_stage_cap: Optional[int] = None  # staging combine buffer
+                                    # capacity in records. None = auto:
+                                    # 64 × host_update_cap (4M records at
+                                    # the default cap). MUST exceed one
+                                    # window's typical distinct count to
+                                    # coalesce anything (the Zipf leg's
+                                    # windows hold ~400K uniques against
+                                    # a 64K update cap — a cap-sized
+                                    # staging buffer never engages), and
+                                    # ideally spans the RUN's distinct
+                                    # count so the whole stream coalesces
+                                    # into one generation. Capacity is
+                                    # near-free: the ping-pong buffers
+                                    # are np.empty (lazily-faulted
+                                    # pages), so resident bytes track the
+                                    # fill actually reached —
+                                    # ~2 × (fill_frac × stage + window) ×
+                                    # 16 B worst case, vocabulary-sized
+                                    # on ordinary corpora. Values below
+                                    # host_update_cap clamp up to it.
     spill_async: bool = True        # binary async spill plane (ISSUE 11):
                                     # budget flushes freeze a snapshot and
                                     # a background writer thread per tier
@@ -292,6 +360,10 @@ class Config:
             raise ValueError("host_map_workers must be >= 1 (or None for auto)")
         if self.fold_shards is not None and self.fold_shards < 1:
             raise ValueError("fold_shards must be >= 1 (or None for auto)")
+        if not 0.0 < self.dispatch_fill_frac <= 1.0:
+            raise ValueError("dispatch_fill_frac must be in (0, 1]")
+        if self.dispatch_stage_cap is not None and self.dispatch_stage_cap < 1:
+            raise ValueError("dispatch_stage_cap must be >= 1 (or None)")
         if self.rpc_timeout_s <= 0:
             raise ValueError("rpc_timeout_s must be positive")
         if self.flight_record_period_s <= 0:
@@ -346,12 +418,19 @@ class Config:
 
     def effective_fold_shards(self) -> int:
         """Resolved egress-fold shard count for the host-map engine. The
-        explicit knob wins; auto stays at 1 (the inline fold, zero queue
-        hops) below 4 usable cores — a fold thread there would just
-        oversubscribe the scan workers — and takes min(4, cores // 2)
-        otherwise: fold work is Python/numpy-bound per shard, so shards
+        explicit knob wins; auto takes min(4, cores // 2) at >= 4 usable
+        cores (fold work is Python/numpy-bound per shard, so shards
         beyond ~half the cores only trade scan parallelism for idle fold
-        threads. ``--fold-shards`` overrides for sweeps."""
+        threads). Below 4 cores auto stays at 1 (the inline fold, zero
+        queue hops) — PR 9 measured fold threads just oversubscribing the
+        then-dispatch-bound router there — EXCEPT when the async dispatch
+        plane has freed the router AND the operator declared a
+        high-cardinality job by setting a dictionary budget: there the
+        off-router fold measurably wins even on 2 cores (ISSUE 13:
+        256 MB Zipf leg 13.0 s -> 12.3 s at S=2 with the dictionary fold
+        as the residual glue wall; the low-cardinality gut leg, which
+        sets no budget, keeps the inline fold it still prefers by ~8%).
+        ``--fold-shards`` overrides for sweeps."""
         if self.fold_shards:
             return max(int(self.fold_shards), 1)
         try:
@@ -359,8 +438,25 @@ class Config:
         except (AttributeError, OSError):  # non-Linux
             n = os.cpu_count() or 1
         if n < 4:
+            if (self.dispatch_async and not sync_dispatch_forced()
+                    and self.dictionary_budget_words is not None):
+                return 2
             return 1
         return min(4, n // 2)
+
+    def effective_dispatch_stage_cap(self) -> int:
+        """Resolved staging-combine capacity of the dispatch plane: the
+        explicit knob (clamped up to the update cap — a staging buffer
+        smaller than one dispatch could never fill one), or 64 × the
+        update cap. The auto multiple is the coalesce window: staging
+        must span MANY windows' distinct keys for cross-window
+        duplication to cancel (at the defaults, 4M records — above the
+        256 MB Zipf leg's 1.62M total distinct, so that whole stream
+        coalesces into one generation). Virtual capacity, resident
+        fill: the buffers fault lazily (see dispatch_stage_cap)."""
+        if self.dispatch_stage_cap is not None:
+            return max(int(self.dispatch_stage_cap), self.host_update_cap)
+        return 64 * self.host_update_cap
 
     def effective_partial_capacity(self) -> int:
         """The per-chunk distinct-key capacity both stream paths must share

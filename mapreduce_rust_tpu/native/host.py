@@ -86,6 +86,14 @@ def get_lib() -> ctypes.CDLL | None:
                 ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
                 ctypes.c_int64,
             ]
+            lib.mr_coalesce_updates.restype = ctypes.c_int64
+            lib.mr_coalesce_updates.argtypes = [
+                ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int64),
+            ]
             lib.mr_merge_runs.restype = ctypes.c_int64
             lib.mr_merge_runs.argtypes = [
                 ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),
@@ -351,6 +359,32 @@ def scan_count_sharded_raw(
         pos[:count].copy(),
         shard_counts,
     )
+
+
+def coalesce_updates_into(a_keys, a_vals, m: int, b_keys, b_vals,
+                          out_keys, out_vals) -> "int | None":
+    """Native staging combine (ISSUE 13: loader.cpp ``mr_coalesce_updates``):
+    merge sorted unique-key column ``a[:m]`` with sorted unique-key column
+    ``b`` into caller-owned ``out_*`` (capacity >= m + len(b)), summing
+    counts on duplicate keys. All arrays must be contiguous uint64/int64
+    and ``out_*`` must not alias either input (the dispatch plane
+    ping-pongs two staging buffers). Returns the merged count, or None
+    when the native lib is unavailable (callers fall back to the
+    vectorized numpy merge in runtime/driver.py)."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "mr_coalesce_updates"):
+        return None
+    n = len(b_keys)
+    return int(lib.mr_coalesce_updates(
+        a_keys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        a_vals.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        int(m),
+        b_keys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        b_vals.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n,
+        out_keys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        out_vals.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    ))
 
 
 def merge_runs_stream(key_arrays, block: int = 1 << 16):

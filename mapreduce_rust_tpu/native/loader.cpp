@@ -554,6 +554,49 @@ int64_t mr_scan_count_sharded(const uint8_t* buf, int64_t len,
   return n;
 }
 
+// Cross-window update coalescing (ISSUE 13): merge two SORTED unique-key
+// (packed-uint64 key, int64 count) columns into one sorted unique-key
+// column, SUMMING counts where a key appears in both — the staging-combine
+// kernel of the device-merge dispatch plane. Window n's grouped scan
+// result folds into the staging buffer here instead of shipping straight
+// to the device: under a Zipf vocabulary most of a window's keys already
+// sit in staging, so the merge dispatch that finally goes out carries one
+// record per distinct key across the coalesced windows, not one per
+// (window, key). Pre-summing is exact for the "sum" combine op and only
+// that op — the Python side gates on it. Inputs must not alias `out_*`
+// (the caller ping-pongs two staging buffers). The linear two-pointer walk
+// is O(m + n) against inputs a scan already paid O(bytes) for.
+// Returns the merged unique-key count (<= m + n).
+int64_t mr_coalesce_updates(const uint64_t* a_keys, const int64_t* a_vals,
+                            int64_t m,
+                            const uint64_t* b_keys, const int64_t* b_vals,
+                            int64_t n,
+                            uint64_t* out_keys, int64_t* out_vals) {
+  int64_t i = 0, j = 0, o = 0;
+  while (i < m && j < n) {
+    uint64_t ka = a_keys[i], kb = b_keys[j];
+    if (ka < kb) {
+      out_keys[o] = ka;
+      out_vals[o++] = a_vals[i++];
+    } else if (kb < ka) {
+      out_keys[o] = kb;
+      out_vals[o++] = b_vals[j++];
+    } else {
+      out_keys[o] = ka;
+      out_vals[o++] = a_vals[i++] + b_vals[j++];
+    }
+  }
+  while (i < m) {
+    out_keys[o] = a_keys[i];
+    out_vals[o++] = a_vals[i++];
+  }
+  while (j < n) {
+    out_keys[o] = b_keys[j];
+    out_vals[o++] = b_vals[j++];
+  }
+  return o;
+}
+
 // k-way disjoint merge over sorted uint64 key columns (ISSUE 11): the
 // batched loser-tree egress that replaces the per-key Python heap
 // interleave of the spill plane. The caller memory-maps each binary run's

@@ -57,7 +57,7 @@ import numpy as np
 
 from mapreduce_rust_tpu.apps.base import App
 from mapreduce_rust_tpu.apps.word_count import WordCount
-from mapreduce_rust_tpu.config import Config
+from mapreduce_rust_tpu.config import Config, sync_dispatch_forced
 from mapreduce_rust_tpu.core.kv import KVBatch
 from mapreduce_rust_tpu.ops.groupby import (
     clamp_batch,
@@ -882,7 +882,31 @@ def _stream_single(cfg: Config, app: App, inputs, stats, acc, dictionary,
     acc.add_batch(state)
 
 
-_PACKED_FNS: dict = {}  # (app, cap) → merge_packed
+#: (app, cap) → merge_packed, LRU-bounded (ISSUE 13 satellite): the old
+#: plain dict grew one compiled merge per (app, cap) FOREVER — a
+#: long-lived multi-job process (ROADMAP item 2) leaked jit executables it
+#: could never drop. Bounded, back-to-back same-config runs still hit the
+#: warm entry (the round-3 "warm == cold" bench killer stays fixed), while
+#: a churn of distinct apps/caps evicts oldest-first.
+_PACKED_FNS: "collections.OrderedDict" = collections.OrderedDict()
+_PACKED_FNS_MAX = 8
+
+
+def clear_packed_fns() -> None:
+    """Explicit clear hook for the packed-merge jit cache: drop every
+    cached closure (their XLA executables free once the last reference
+    dies). For embedders that KNOW no further host-engine run is coming —
+    run_job's own teardown calls :func:`trim_packed_fns` instead, which
+    keeps the warm path for repeated jobs."""
+    _PACKED_FNS.clear()
+
+
+def trim_packed_fns(limit: int = _PACKED_FNS_MAX) -> None:
+    """Evict least-recently-used packed-merge closures beyond ``limit`` —
+    wired into run_job teardown so a multi-job process holds a bounded
+    working set instead of one executable per (app, cap) ever seen."""
+    while len(_PACKED_FNS) > max(int(limit), 0):
+        _PACKED_FNS.popitem(last=False)
 
 
 def make_packed_merge_fn(app: App, cap: int):
@@ -901,6 +925,7 @@ def make_packed_merge_fn(app: App, cap: int):
     key = (app, cap)
     fn = _PACKED_FNS.get(key)
     if fn is not None:
+        _PACKED_FNS.move_to_end(key)  # LRU: reuse refreshes recency
         return fn
     op = app.combine_op
 
@@ -918,12 +943,16 @@ def make_packed_merge_fn(app: App, cap: int):
         return new_state, evicted, ev_count
 
     _PACKED_FNS[key] = merge_packed
+    trim_packed_fns()  # the bound holds at every insert, not only job end
     return merge_packed
 
 
 def _pack_update(keys: np.ndarray, values: np.ndarray, cap: int) -> np.ndarray:
     """Lay one window's (keys uint32[n,2], values) into the flat layout
-    make_packed_merge_fn expects."""
+    make_packed_merge_fn expects. The reference packer: allocates (and
+    memsets) a fresh buffer per call — the dispatch plane's _PackStager
+    produces byte-identical output from a persistent buffer (the test
+    suite holds the two equal)."""
     n = len(keys)
     flat = np.full(1 + 3 * cap, 0xFFFFFFFF, dtype=np.uint32)  # SENTINEL pad
     flat[0] = n
@@ -931,6 +960,527 @@ def _pack_update(keys: np.ndarray, values: np.ndarray, cap: int) -> np.ndarray:
     flat[1 + cap : 1 + cap + n] = keys[:, 1]
     flat[1 + 2 * cap : 1 + 2 * cap + n] = np.asarray(values, dtype=np.uint32)
     return flat
+
+
+class _PackStager:
+    """Zero-memset packed-update staging (ISSUE 13 tentpole b): ONE
+    persistent ``1 + 3·cap`` uint32 buffer reused across dispatches,
+    re-sentineling only the previously-dirty prefix beyond the new fill.
+    The old per-dispatch ``np.full`` was a ~786 KB allocate+memset at the
+    default cap even for a 100-word tail window; here a small window
+    touches O(n) bytes plus whatever the LAST window dirtied — by
+    construction byte-identical to :func:`_pack_update`'s output.
+
+    Reuse safety: ``jax.device_put`` COPIES the host buffer on the CPU
+    backend (measured on this image — mutate-after-put does not alter the
+    device array), so the buffer is free the moment the put returns. On
+    accelerator backends the host→device transfer may be asynchronous
+    w.r.t. the source buffer; ``needs_barrier`` tells the dispatch plane
+    to wait for the put (``block_until_ready`` on the INPUT array — a
+    dispatch-thread-local sync the router never sees) before this buffer
+    is dirtied again."""
+
+    SENTINEL = np.uint32(0xFFFFFFFF)
+
+    def __init__(self, cap: int, device) -> None:
+        self.cap = cap
+        self.flat = np.full(1 + 3 * cap, self.SENTINEL, dtype=np.uint32)
+        self.dirty = 0  # records of the previous pack still in the buffer
+        self.needs_barrier = getattr(device, "platform", "cpu") != "cpu"
+
+    def pack(self, k1: np.ndarray, k2: np.ndarray,
+             vals: np.ndarray) -> np.ndarray:
+        n = len(k1)
+        cap, flat, dirty = self.cap, self.flat, self.dirty
+        if dirty > n:  # re-sentinel ONLY the stale tail of each section
+            flat[1 + n : 1 + dirty] = self.SENTINEL
+            flat[1 + cap + n : 1 + cap + dirty] = self.SENTINEL
+            flat[1 + 2 * cap + n : 1 + 2 * cap + dirty] = self.SENTINEL
+        flat[0] = n
+        flat[1 : 1 + n] = k1
+        flat[1 + cap : 1 + cap + n] = k2
+        flat[1 + 2 * cap : 1 + 2 * cap + n] = vals
+        self.dirty = n
+        return flat
+
+
+def _coalesce_updates_py(a_keys, a_vals, m, b_keys, b_vals):
+    """Vectorized numpy fallback for ``mr_coalesce_updates`` (no native
+    toolchain): merge two sorted unique-key columns, summing counts on
+    duplicate keys. Same output, one concatenate+argsort instead of the
+    linear walk."""
+    keys = np.concatenate([a_keys[:m], b_keys])
+    vals = np.concatenate([a_vals[:m], b_vals])
+    order = np.argsort(keys, kind="stable")
+    ks, vs = keys[order], vals[order]
+    if not len(ks):
+        return ks, vs
+    first = np.empty(len(ks), dtype=bool)
+    first[0] = True
+    first[1:] = ks[1:] != ks[:-1]
+    idx = np.nonzero(first)[0]
+    return ks[idx], np.add.reduceat(vs, idx)
+
+
+# ---------------------------------------------------------------------------
+# slow_dispatch chaos checkpoint (ISSUE 13 satellite) — the spill plane's
+# slow_disk pattern: seeded per-merge-dispatch delay, MR_CHAOS only (the
+# env form rides a whole process tree), cached per spec string.
+# ---------------------------------------------------------------------------
+
+_dispatch_chaos_cache: dict = {}
+
+
+def _chaos_slow_dispatch(dispatch_index: int) -> None:
+    """The ``slow_dispatch`` injection checkpoint: ONE site in the dispatch
+    plane (fires per merge dispatch, so ``p=`` samples by dispatch index
+    and reruns delay the same dispatches). The async plane hides the delay
+    on the dispatch thread; the sync plane eats it on the router's wall —
+    the pair bench.py measures."""
+    spec = os.environ.get("MR_CHAOS")
+    if not spec:
+        return
+    plan = _dispatch_chaos_cache.get(spec)
+    if plan is None:
+        try:
+            from mapreduce_rust_tpu.analysis.chaos import ChaosPlan
+
+            plan = ChaosPlan.parse(spec)
+        except Exception:
+            plan = False  # a bad ambient spec must not fail dispatches
+        _dispatch_chaos_cache[spec] = plan
+    if not plan:
+        return
+    f = plan.pick("slow_dispatch", tid=dispatch_index)
+    if f is not None and f.seconds > 0:
+        time.sleep(f.seconds)
+
+
+def dispatch_chaos_fired(spec: str) -> list:
+    """Fired slow_dispatch events for ``spec`` (test/bench introspection)."""
+    plan = _dispatch_chaos_cache.get(spec)
+    return plan.fired() if plan else []
+
+
+# (sync_dispatch_forced is imported from config at the top of this module:
+# the fold-shard auto heuristic reads the SAME check — one definition, so
+# the plane and the heuristic can never disagree on what counts as async.)
+
+
+class _DispatchPlane:
+    """The device-merge dispatch plane (ISSUE 13 tentpole): scan-order
+    scatter-back, update pack, ``device_put`` and the compiled packed
+    merge — the per-window host→device hop that PR 10's doctor measured
+    as ~13 s of host-glue on the Zipf leg — run on ONE dedicated
+    depth-bounded dispatch thread. The router hands off O(1) per window
+    (a tuple of already-materialized scan arrays) and goes back to
+    routing; glue stops booking device hops.
+
+    Three costs die here:
+
+    - **cross-window coalescing** (``Config.dispatch_coalesce``, "sum"
+      apps only — pre-summing any other op would be wrong): successive
+      windows' (packed-key, count) columns merge into a staging combine
+      buffer (``mr_coalesce_updates``: sorted linear merge, duplicate
+      keys sum), and a device merge dispatches only when fill crosses
+      ``dispatch_fill_frac·cap`` or the stream ends — under a Zipf
+      vocabulary most of a window's keys already sit in staging, so far
+      fewer records ship;
+    - **zero-memset staging** (:class:`_PackStager`): the per-dispatch
+      ``np.full(1 + 3·cap)`` becomes a persistent buffer that
+      re-sentinels only the previously-dirty prefix;
+    - **serialized dispatch**: the jit call and its drain readbacks run
+      off the router thread entirely (``--sync-dispatch`` /
+      ``MR_DISPATCH_SYNC=1`` keeps the inline path for A/B).
+
+    Exactness: the dispatch stream is a pure function of the window
+    sequence (which the router consumes in window order) and the dispatch
+    config — never of (host_map_workers, fold_shards) — so outputs stay
+    bit-identical across the whole (W, S) matrix at a fixed dispatch
+    config; with coalescing OFF the stream is exactly PR 10's, sync or
+    async. Coalescing changes WHICH merges the device sees (sorted,
+    pre-summed), not what they sum to: oracle-exact by associativity.
+
+    Failure containment is the PR 9/10 plane pattern verbatim: a dispatch
+    error poisons the plane, the dead thread keeps DRAINING its queue so
+    the router's bounded ``submit`` can never deadlock, the original
+    error re-raises on the router at the next submit or at ``finish``,
+    and ``abort`` forces the sentinel past a full queue.
+    """
+
+    _SENTINEL = object()
+    _QUEUE_DEPTH = 8  # windows in flight router→dispatch; each pins one
+    # window's scan arrays (shared read-only with the fold plane's slices)
+
+    def __init__(self, cfg: Config, app: App, stats: JobStats, acc,
+                 dictionary, device) -> None:
+        import queue
+        import threading
+
+        self.app = app
+        self.stats = stats
+        self.acc = acc
+        self.dictionary = dictionary
+        self.device = device
+        self.cap = cfg.host_update_cap
+        self.depth = max(cfg.pipeline_depth, 1)
+        self.sync = (not cfg.dispatch_async) or sync_dispatch_forced()
+        self.coalesce = bool(cfg.dispatch_coalesce) \
+            and app.combine_op == "sum"
+        self.stage_cap = cfg.effective_dispatch_stage_cap()
+        self.fill_threshold = max(
+            1, min(self.stage_cap,
+                   int(round(cfg.dispatch_fill_frac * self.stage_cap)))
+        )
+        self.merge_packed = make_packed_merge_fn(app, self.cap)
+        self.state = jax.device_put(KVBatch.empty(cfg.merge_capacity), device)
+        self.pending: collections.deque = collections.deque()  # (ev, evicted)
+        self._stager = _PackStager(self.cap, device)
+        if self.coalesce:
+            # Ping-pong staging pair, sized stage_cap (SEVERAL windows of
+            # distinct keys — a cap-sized buffer would never coalesce a
+            # high-cardinality window; see Config.dispatch_stage_cap):
+            # the native merge writes into the OTHER buffer (inputs must
+            # not alias outputs), then the roles swap — no allocation per
+            # window.
+            self._skeys = [
+                np.empty(self.stage_cap, np.uint64) for _ in range(2)
+            ]
+            self._svals = [
+                np.empty(self.stage_cap, np.int64) for _ in range(2)
+            ]
+            self._scur = 0
+            self._sn = 0
+        # Plane-local tallies (the fold-plane doctrine): the dispatch
+        # thread owns these cells; the router publishes benign-stale
+        # copies per window (publish_live) and collect() writes the exact
+        # finals after the join.
+        self.dispatch_s = 0.0        # thread seconds in scatter/pack/put/jit
+        self.stall_s = 0.0           # router blocked on a full queue + join
+        self.idle_s = 0.0            # thread seconds waiting for windows
+        self.device_wait_s = 0.0
+        self.spill_events = 0
+        self.spilled_keys = 0
+        self.merge_dispatches = 0
+        self.records_shipped = 0
+        self.submit_hist = Histogram()   # per-dispatch pack+put+jit seconds
+        self.drain_hist = Histogram()    # per-drain blocking readback
+        self.error: "BaseException | None" = None
+        self.poisoned = threading.Event()
+        self._finished = False
+        stats.dispatch_mode = ("sync" if self.sync else "async") \
+            + ("+coalesce" if self.coalesce else "")
+        if self.sync:
+            self._q = None
+            self._thread = None
+            return
+        self._q: "queue.Queue" = queue.Queue(maxsize=self._QUEUE_DEPTH)
+        self._thread = threading.Thread(
+            target=self._loop, name="merge-dispatch", daemon=True
+        )
+        self._thread.start()
+
+    # ---- dispatch thread ----
+
+    def _loop(self) -> None:
+        # Sanitizer registration: this thread legitimately writes
+        # device_mem_high_bytes (via _sample_device_memory) — every other
+        # tally is plane-local until collect().
+        self.stats.register_writer()
+        q = self._q
+        saw_sentinel = False
+        try:
+            while True:
+                t0 = time.perf_counter()
+                item = q.get()
+                self.idle_s += time.perf_counter() - t0
+                if item is self._SENTINEL:
+                    saw_sentinel = True
+                    if not self.poisoned.is_set():
+                        self._finalize()
+                    return
+                if self.poisoned.is_set():
+                    continue  # poisoned: drain, don't dispatch
+                self._handle(item)
+        except BaseException as e:
+            self.error = e
+            self.poisoned.set()
+            if not saw_sentinel:
+                # Keep consuming (discarding) until the sentinel: the
+                # router's bounded put must never deadlock against a dead
+                # dispatch thread.
+                while q.get() is not self._SENTINEL:
+                    pass
+
+    def _handle(self, item) -> None:
+        """One window: scatter back to exact scan order, apply the
+        filtering app's mask, stamp values, then coalesce-or-dispatch."""
+        doc_id, kind, keys, counts, pos, mask = item
+        t0 = time.perf_counter()
+        with trace_span("dispatch.window", doc=doc_id, n=len(keys)):
+            if kind == "sharded":
+                # Grouped scan result: scatter keys/counts (and the mask,
+                # computed on grouped rows) back to EXACT scan order so
+                # the merge stream matches the unsharded engine's.
+                keys_d = np.empty_like(keys)
+                keys_d[pos] = keys
+                counts_d = np.empty_like(counts)
+                counts_d[pos] = counts
+                if mask is not None:  # filtering app: query keys only
+                    mask_d = np.empty(len(pos), dtype=bool)
+                    mask_d[pos] = mask
+                    keys_d, counts_d = keys_d[mask_d], counts_d[mask_d]
+                keys, counts = keys_d, counts_d
+            elif mask is not None:  # filtering app: query keys only
+                keys, counts = keys[mask], counts[mask]
+            values = self.app.host_values(counts, doc_id)
+            if self.coalesce:
+                self._coalesce_window(keys, values)
+            else:
+                # PR 10 stream verbatim: scan order, split at cap.
+                cap = self.cap
+                for start in range(0, len(keys), cap):
+                    ks = keys[start : start + cap]
+                    self._dispatch(
+                        ks[:, 0], ks[:, 1],
+                        np.asarray(values[start : start + cap],
+                                   dtype=np.uint32),
+                    )
+        self.dispatch_s += time.perf_counter() - t0
+
+    def _coalesce_window(self, keys: np.ndarray, values) -> None:
+        from mapreduce_rust_tpu.native.host import coalesce_updates_into
+
+        packed = (keys[:, 0].astype(np.uint64) << np.uint64(32)) \
+            | keys[:, 1].astype(np.uint64)
+        order = np.argsort(packed, kind="stable")
+        pk = np.ascontiguousarray(packed[order])
+        pv = np.ascontiguousarray(
+            np.asarray(values, dtype=np.int64)[order]
+        )
+        n = len(pk)
+        if self._sn + n > self.stage_cap:
+            # The merged result may not fit: flush first. Conservative
+            # (duplicates could have made it fit), but deterministic and
+            # cheap — and fill_threshold <= stage_cap means staging
+            # flushes well before this bound matters under normal shapes.
+            self._flush_staging()
+        if n >= self.stage_cap:
+            # A window wider than the whole staging buffer ships
+            # directly, in sorted cap-sized slices — never through
+            # staging (with the auto 64x stage cap this is the
+            # degenerate single-giant-window shape only).
+            for start in range(0, n, self.cap):
+                self._dispatch_packed(pk[start : start + self.cap],
+                                      pv[start : start + self.cap])
+            return
+        cur, nxt = self._scur, 1 - self._scur
+        m = coalesce_updates_into(
+            self._skeys[cur], self._svals[cur], self._sn, pk, pv,
+            self._skeys[nxt], self._svals[nxt],
+        )
+        if m is None:  # no native lib: vectorized numpy merge
+            ks, vs = _coalesce_updates_py(
+                self._skeys[cur], self._svals[cur], self._sn, pk, pv
+            )
+            m = len(ks)
+            self._skeys[nxt][:m] = ks
+            self._svals[nxt][:m] = vs
+        self._scur, self._sn = nxt, int(m)
+        if self._sn >= self.fill_threshold:
+            self._flush_staging()
+
+    def _flush_staging(self) -> None:
+        """Ship the staging combine buffer as cap-sized packed merges
+        (sorted, pre-summed): every chunk but the tail goes out 100%
+        full — the record-count reduction IS the coalesce factor."""
+        if not self.coalesce or self._sn == 0:
+            return
+        cur, n = self._scur, self._sn
+        self._sn = 0
+        for start in range(0, n, self.cap):
+            # Clip the tail chunk at the FILL, not the buffer: a bare
+            # [start : start+cap] slice clips at stage_cap and would ship
+            # stale staging slots beyond n as real records.
+            end = min(start + self.cap, n)
+            self._dispatch_packed(self._skeys[cur][start:end],
+                                  self._svals[cur][start:end])
+
+    def _dispatch_packed(self, pk: np.ndarray, pv: np.ndarray) -> None:
+        # int64 staging counts → the uint32 bit pattern the packed layout
+        # carries (the device accumulates in int32 two's complement, so
+        # pre-summing mod 2^32 is bit-exact against per-window merges).
+        self._dispatch(
+            (pk >> np.uint64(32)).astype(np.uint32),
+            (pk & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+            pv.astype(np.uint32),
+        )
+
+    def _dispatch(self, k1: np.ndarray, k2: np.ndarray,
+                  vals: np.ndarray) -> None:
+        t0 = time.perf_counter()
+        _chaos_slow_dispatch(self.merge_dispatches)
+        flat = self._stager.pack(k1, k2, vals)
+        with trace_span("dispatch.submit", n=len(k1)):
+            flat_dev = jax.device_put(flat, self.device)
+            if self._stager.needs_barrier:
+                # Accelerator backends: the put may read the host buffer
+                # asynchronously — wait before the stager dirties it again
+                # (CPU copies eagerly; see _PackStager).
+                flat_dev.block_until_ready()
+            self.state, evicted, ev_count = self.merge_packed(
+                self.state, flat_dev
+            )
+        self.pending.append((ev_count, evicted))
+        self.merge_dispatches += 1
+        self.records_shipped += len(k1)
+        self.submit_hist.add(time.perf_counter() - t0)
+        if len(self.pending) >= 2 * self.depth:
+            self._drain(self.depth)
+
+    def _drain(self, n: int) -> None:
+        # One batched readback per window batch — see _stream_single.drain.
+        if n <= 0:
+            return
+        batch = [self.pending.popleft() for _ in range(n)]
+        t0 = time.perf_counter()
+        with trace_span("device.drain", steps=n):
+            counts = jax.device_get([ev for ev, _ in batch])
+        dt = time.perf_counter() - t0
+        self.device_wait_s += dt
+        self.drain_hist.add(dt)
+        _sample_device_memory(self.stats)
+        for (ev, evicted), ev_n in zip(batch, counts):
+            if int(ev_n) > 0:
+                self.spill_events += 1
+                self.spilled_keys += int(ev_n)
+                with trace_span("spill", keys=int(ev_n)):
+                    self.acc.add_batch(evicted)
+
+    def _finalize(self) -> None:
+        """End-of-stream: flush the staging combine buffer, resolve every
+        pending merge. Runs on the dispatch thread (async) or the router
+        (sync) — after it, ``self.state`` is the complete device fold."""
+        self._flush_staging()
+        self._drain(len(self.pending))
+
+    # ---- router side ----
+
+    def _raise_error(self) -> None:
+        if self.error is not None:
+            raise self.error
+        raise RuntimeError("dispatch plane poisoned without a recorded error")
+
+    def submit(self, item) -> None:
+        """Hand one window to the plane — O(1) for the router (sync mode
+        runs the dispatch inline, the PR 10 path). Blocked = dispatch
+        backpressure, timed into ``stall_s`` — the wall-clock "the
+        dispatch is the ceiling" signal, exactly as fold_stall_s is for
+        the fold."""
+        import queue as _queue
+
+        if self.sync:
+            self._handle(item)
+            return
+        if self.poisoned.is_set():
+            self._raise_error()
+        try:
+            self._q.put_nowait(item)
+            return
+        except _queue.Full:
+            pass
+        t0 = time.perf_counter()
+        try:
+            with trace_span("host_map.dispatch_stall"):
+                while True:
+                    if self.poisoned.is_set():
+                        self._raise_error()
+                    try:
+                        self._q.put(item, timeout=0.05)
+                        return
+                    except _queue.Full:
+                        continue
+        finally:
+            self.stall_s += time.perf_counter() - t0
+
+    def finish(self) -> None:
+        """Clean end-of-stream: sentinel, join, surface any dispatch
+        error — called AFTER the last window was submitted. The join wall
+        (the plane catching up on its backlog + the final drain) counts
+        as dispatch stall, mirroring the fold plane's accounting."""
+        if self._finished:
+            return
+        self._finished = True
+        if self.sync:
+            self._finalize()
+            return
+        t0 = time.perf_counter()
+        self._q.put(self._SENTINEL)
+        self._thread.join()
+        self.stall_s += time.perf_counter() - t0
+        if self.poisoned.is_set():
+            self._raise_error()
+
+    def abort(self) -> None:
+        """Exception-path teardown: poison (the thread discards its
+        backlog), force a sentinel past a full queue by displacing one
+        item, reap the thread. Idempotent, never raises, never blocks
+        forever."""
+        import queue as _queue
+
+        self.poisoned.set()
+        if self._finished:
+            return
+        self._finished = True
+        if self.sync:
+            return
+        while True:
+            try:
+                self._q.put_nowait(self._SENTINEL)
+                break
+            except _queue.Full:
+                try:
+                    self._q.get_nowait()
+                except _queue.Empty:
+                    pass
+        self._thread.join(timeout=10)
+
+    def mean_fill_frac(self) -> float:
+        if not self.merge_dispatches:
+            return 0.0
+        return self.records_shipped / (self.merge_dispatches * self.cap)
+
+    def publish_live(self, stats: JobStats) -> None:
+        """Per-window live publication (router thread): the plane's cells
+        are benign-stale at worst — the live ring, the fleet view and the
+        streaming doctor must see a dispatch-bound job DURING the run
+        (the PR 9 fold_s pattern). collect() writes the exact finals."""
+        stats.dispatch_s = self.dispatch_s
+        stats.dispatch_stall_s = self.stall_s
+        stats.merge_dispatches = self.merge_dispatches
+        stats.merge_fill_frac = round(self.mean_fill_frac(), 6)
+        stats.device_wait_s = self.device_wait_s
+        stats.spill_events = self.spill_events
+        stats.spilled_keys = self.spilled_keys
+
+    def collect(self, stats: JobStats) -> None:
+        """Fold the plane's tallies into JobStats — router thread only,
+        after finish/abort joined the thread (the fold-plane collect
+        doctrine)."""
+        stats.dispatch_s = self.dispatch_s
+        stats.dispatch_stall_s = self.stall_s
+        stats.merge_dispatches = self.merge_dispatches
+        stats.merge_fill_frac = round(self.mean_fill_frac(), 6)
+        stats.device_wait_s = self.device_wait_s
+        stats.spill_events = self.spill_events
+        stats.spilled_keys = self.spilled_keys
+        for name, h in (("dispatch.submit_s", self.submit_hist),
+                        ("device.drain_s", self.drain_hist)):
+            if h.count:
+                agg = stats.hists.get(name)
+                if agg is None:
+                    agg = stats.hists[name] = Histogram()
+                agg.merge(h)
 
 
 class _FoldShardPlane:
@@ -1277,7 +1827,6 @@ def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
 
     enable_compilation_cache(cfg.compilation_cache_dir)
     device = select_device(cfg.device)
-    depth = max(cfg.pipeline_depth, 1)
     workers = cfg.effective_host_map_workers()
     stats.host_map_workers = workers
     fold_n = (
@@ -1287,27 +1836,9 @@ def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
     fold: "_FoldShardPlane | None" = None  # started right before the
     # stream loop's try block — device setup below can raise, and fold
     # threads started earlier would leak, blocked forever on q.get()
-    state = jax.device_put(KVBatch.empty(cfg.merge_capacity), device)
-    pending: collections.deque = collections.deque()  # (ev_count, evicted)
-
-    def drain(n: int) -> None:
-        # One batched readback per window batch — see _stream_single.drain.
-        if n <= 0:
-            return
-        batch = [pending.popleft() for _ in range(n)]
-        t0 = time.perf_counter()
-        with trace_span("device.drain", steps=n):
-            counts = jax.device_get([ev for ev, _ in batch])
-        dt = time.perf_counter() - t0
-        stats.device_wait_s += dt
-        stats.record_hist("device.drain_s", dt)
-        _sample_device_memory(stats)
-        for (ev, evicted), ev_n in zip(batch, counts):
-            if int(ev_n) > 0:
-                stats.spill_events += 1
-                stats.spilled_keys += int(ev_n)
-                with trace_span("spill", keys=int(ev_n)):
-                    acc.add_batch(evicted)
+    # The dispatch plane (ISSUE 13) owns the device state, the pending
+    # merges and their drain: the router below never books a device hop.
+    dispatch = _DispatchPlane(cfg, app, stats, acc, dictionary, device)
 
     def scan_window(item):
         # PURE: reads its window, returns its result + its own duration.
@@ -1334,7 +1865,6 @@ def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
         return (*out, time.perf_counter() - t0)
 
     def consume(result) -> None:
-        nonlocal state
         doc_id, kind, res, scan_s = result
         stats.host_map_s += scan_s  # aggregate scan seconds across workers
         # Per-window scan distribution: a high-cardinality window shows up
@@ -1342,35 +1872,32 @@ def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
         stats.record_hist("host_map.scan_s", scan_s)
         t_glue = time.perf_counter()
         stall0 = fold.stall_s if fold is not None else 0.0
+        dstall0 = dispatch.stall_s
+        dwait0 = dispatch.device_wait_s
         with trace_span("host_glue"):
             stats.chunks += 1
             if kind == "raw_sharded":
                 # Sharded fold (ISSUE 9): route each shard its
                 # pre-partitioned slice — O(shards) router work, the fold
-                # threads do the word-level folding — then scatter
-                # keys/counts back to EXACT scan order for the device
-                # merge. The update stream the device sees is identical to
-                # the unsharded engine's, so merge evictions (and with
-                # them spill totals and outputs) cannot depend on
-                # fold_shards.
+                # threads do the word-level folding. The scan-order
+                # scatter-back for the device merge moved to the dispatch
+                # plane (ISSUE 13): the router hands the grouped arrays +
+                # permutation over and is done in O(1).
                 raw, ends, keys, counts, pos, shard_counts = res
                 mask = app.host_mask(keys)  # grouped rows; per-row exact
                 fold.route_raw(raw, ends, keys, shard_counts, mask)
-                keys_d = np.empty_like(keys)
-                keys_d[pos] = keys
-                counts_d = np.empty_like(counts)
-                counts_d[pos] = counts
-                if mask is not None:  # filtering app: query keys only
-                    mask_d = np.empty(len(pos), dtype=bool)
-                    mask_d[pos] = mask
-                    keys_d, counts_d = keys_d[mask_d], counts_d[mask_d]
-                keys, counts = keys_d, counts_d
+                dispatch.submit(
+                    (doc_id_offset + doc_id, "sharded", keys, counts, pos,
+                     mask)
+                )
             elif kind == "raw":
                 raw, ends, keys, counts = res
                 mask = app.host_mask(keys)
                 fold_scan_into_dictionary(dictionary, mask, "raw", (raw, ends, keys))
-                if mask is not None:  # filtering app: query keys only
-                    keys, counts = keys[mask], counts[mask]
+                dispatch.submit(
+                    (doc_id_offset + doc_id, "flat", keys, counts, None,
+                     mask)
+                )
             else:
                 words, keys, counts = res
                 mask = app.host_mask(keys)
@@ -1381,29 +1908,27 @@ def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
                     fold.route_list(words, keys, mask)
                 else:
                     fold_scan_into_dictionary(dictionary, mask, "list", (words, keys))
-                if mask is not None:  # filtering app: query keys only
-                    keys, counts = keys[mask], counts[mask]
-            values = app.host_values(counts, doc_id_offset + doc_id)
-            # Fixed update capacity, splitting big windows across merges: ONE
-            # compiled merge shape for the whole run (a variable cap means a
-            # ragged tail window triggers a fresh multi-10s XLA compile).
-            cap = cfg.host_update_cap
-            merge_packed = make_packed_merge_fn(app, cap)
-            for start in range(0, len(keys), cap):
-                flat = jax.device_put(
-                    _pack_update(keys[start : start + cap], values[start : start + cap], cap),
-                    device,
+                dispatch.submit(
+                    (doc_id_offset + doc_id, "flat", keys, counts, None,
+                     mask)
                 )
-                state, evicted, ev_count = merge_packed(state, flat)
-                pending.append((ev_count, evicted))
-        # Glue stops before drain: drain's blocking readback is already
-        # accounted in device_wait_s and must not be double-counted. Time
-        # the router spent BLOCKED on full shard queues is fold
-        # backpressure (fold_stall_s), not glue — subtracted so glue keeps
-        # meaning "router's own work".
+        # Glue accounting: time the router spent BLOCKED on full shard or
+        # dispatch queues is backpressure (fold_stall_s /
+        # dispatch_stall_s), not glue — subtracted so glue keeps meaning
+        # "router's own work". In SYNC dispatch mode the inline dispatch
+        # runs inside the glue span exactly as PR 10 booked it (that is
+        # the A/B: sync shows the device hops in glue, async doesn't) —
+        # only the drain's blocking readback is subtracted, which
+        # device_wait_s already owns.
         glue_dt = time.perf_counter() - t_glue
         if fold is not None:
             glue_dt = max(glue_dt - (fold.stall_s - stall0), 0.0)
+        if dispatch.sync:
+            glue_dt = max(
+                glue_dt - (dispatch.device_wait_s - dwait0), 0.0
+            )
+        else:
+            glue_dt = max(glue_dt - (dispatch.stall_s - dstall0), 0.0)
         stats.host_glue_s += glue_dt
         stats.record_hist("host_map.glue_s", glue_dt)
         if fold is not None:
@@ -1416,14 +1941,15 @@ def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
             # collect() writes the exact finals at teardown.
             stats.fold_s = sum(fold.fold_s)
             stats.fold_stall_s = fold.stall_s
+        # Running dispatch totals, same contract (ISSUE 13): a
+        # dispatch-bound job must name merge-dispatch in the live ring.
+        dispatch.publish_live(stats)
         # Running spill totals, same live-publication contract as fold_s:
         # a spill-bound job must name "spill" in the live ring, not just
         # in the post-mortem manifest (ISSUE 11).
         _publish_spill_live(stats, dictionary, acc)
         maybe_snapshot()  # flight-recorder tick: per window, consumer thread
         metrics_tick()    # live-metrics sampler, same piggyback contract
-        if len(pending) >= 2 * depth:
-            drain(depth)
 
     from concurrent.futures import ThreadPoolExecutor
 
@@ -1444,7 +1970,7 @@ def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
         stats.scan_wait_s += dt
         stats.record_hist("host_map.stall_s", dt)
         trace_counter("host_map.inflight", scans=len(inflight),
-                      merges=len(pending))
+                      merges=len(dispatch.pending))  # benign-stale len read
         return res
 
     pool = ThreadPoolExecutor(max_workers=workers, thread_name_prefix="host-map")
@@ -1453,8 +1979,14 @@ def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
         # during setup (device selection/state allocation, pool creation)
         # is behind us, and the very next statement is the try whose
         # except/finally owns the plane's teardown — no window where an
-        # exception strands S fold threads on q.get().
-        fold = _FoldShardPlane(cfg, stats, dictionary.shards)
+        # exception strands S fold threads on q.get(). The dispatch plane
+        # started earlier (its ctor allocates device state), so a fold
+        # ctor failure must unwind it.
+        try:
+            fold = _FoldShardPlane(cfg, stats, dictionary.shards)
+        except BaseException:
+            dispatch.abort()
+            raise
     try:
         for item in _iter_windows(cfg, inputs, stats):
             inflight.append(pool.submit(scan_window, item))
@@ -1466,26 +1998,29 @@ def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
         if fold is not None:
             # Teardown ORDER (ISSUE 9 satellite): the router is fully
             # drained (every scan result routed above), THEN the fold
-            # threads flush and join, THEN the device merge drains below —
-            # each stage's producers are gone before it stops. A fold
-            # error recorded mid-stream surfaces here (or at the route
-            # that first observed the poison).
+            # threads flush and join, THEN the dispatch plane flushes its
+            # staging buffer + drains the device merges — each stage's
+            # producers are gone before it stops. A fold error recorded
+            # mid-stream surfaces here (or at the route that first
+            # observed the poison).
             fold.finish()
+        dispatch.finish()
     except BaseException:
         if fold is not None:
             fold.abort()
+        dispatch.abort()
         raise
     finally:
         if fold is not None:
             fold.collect(stats)  # threads joined by finish()/abort()
+        dispatch.collect(stats)  # same doctrine: joined before collect
         # cancel_futures + wait (the old wait=False shutdown abandoned an
         # in-flight scan on exception: the orphaned future kept its memmap
         # window alive past the stream's unwind — ISSUE 2 satellite).
         # Queued futures cancel; the ≤ workers running scans finish their
         # pure work and are reaped before the stream frame exits.
         pool.shutdown(wait=True, cancel_futures=True)
-    drain(len(pending))
-    acc.add_batch(state)
+    acc.add_batch(dispatch.state)
 
 
 def _ckpt_paths(cfg: Config) -> tuple[str, str]:
@@ -2405,6 +2940,11 @@ def run_job(
         acc.remove_runs()
         dictionary.remove_runs()
         _collect_spill_stats(stats, dictionary, acc)
+        # Packed-merge jit cache hygiene (ISSUE 13 satellite): enforce the
+        # LRU bound at job teardown so a long-lived multi-job process
+        # (ROADMAP item 2) holds a bounded working set of compiled merges
+        # — clear_packed_fns() is the full-drop hook for embedders.
+        trim_packed_fns()
         if tracer is not None:
             stop_tracing()
         if tracer is not None or cfg.manifest_path:

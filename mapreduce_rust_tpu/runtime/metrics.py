@@ -123,6 +123,27 @@ class JobStats:
     spill_bytes: int = 0          # bytes written to spill runs (both tiers)
     merge_fanin: int = 0          # sources the egress k-way merge saw
     # (runs + RAM tiers across every shard; 0 = in-RAM egress)
+    # ---- device-merge dispatch plane (ISSUE 13) ----
+    dispatch_mode: str = ""       # "" = plane not used (non-host engines);
+    # "async"/"sync" + "+coalesce" when cross-window coalescing engaged —
+    # every manifest says which dispatch plane produced its numbers
+    dispatch_s: float = 0.0       # dispatch-thread seconds in scan-order
+    # scatter-back + staging combine + pack + device_put + the jit call —
+    # with the async plane this is overlapped (hidden) time made visible,
+    # exactly like spill_s for the writers; in sync mode the same work is
+    # also part of host_glue_s (the PR 10 accounting, kept for A/B)
+    dispatch_stall_s: float = 0.0  # router wall seconds blocked on a full
+    # dispatch queue plus the end-of-stream join: the wall-clock "the
+    # dispatch is the ceiling" signal, exactly as fold_stall_s is for the
+    # fold — large means the device hop itself (or the coalesce combine)
+    # is slower than the scans feeding it
+    merge_dispatches: int = 0     # packed device merges dispatched (with
+    # coalescing this is windows ÷ coalesce factor, the lever the plane
+    # exists to pull)
+    merge_fill_frac: float = 0.0  # mean records-per-dispatch ÷ cap: how
+    # full the fixed-shape update actually was. Low = the 1+3·cap
+    # transfer is mostly sentinel padding (lower host_update_cap or raise
+    # dispatch_fill_frac); the doctor's merge-dispatch finding reads this
     scan_wait_s: float = 0.0      # consumer wall time blocked waiting for
     # the next IN-ORDER scan result: the parallel engine's starvation
     # signal — large scan_wait means more workers (or a faster scan) would
@@ -207,6 +228,14 @@ class JobStats:
             # host-fold. (The doctor's _bottleneck_attribution mirrors
             # this arm exactly; keep them in lockstep.)
             parts["spill"] = self.spill_stall_s
+        if self.dispatch_mode.startswith("async"):
+            # Async dispatch plane (ISSUE 13): the device hop runs off the
+            # router, so "the dispatch is the ceiling" reads as router
+            # backpressure — same stall logic again. Sync mode keeps the
+            # PR 10 attribution (the hop is glue), so the arm stays off
+            # there and the A/B story stays honest. (Doctor mirror:
+            # _bottleneck_attribution, keep in lockstep.)
+            parts["merge-dispatch"] = self.dispatch_stall_s
         name, val = max(parts.items(), key=lambda kv: kv[1])
         return name if val > 0 else "balanced"
 
@@ -248,6 +277,13 @@ class JobStats:
             + (
                 f" spillw={self.spill_s:.2f}s sstall={self.spill_stall_s:.2f}s"
                 if self.spill_s > 0 or self.spill_stall_s > 0 else ""
+            )
+            + (
+                f" disp[{self.dispatch_mode}]={self.dispatch_s:.2f}s"
+                f"/{self.merge_dispatches}m "
+                f"fill={self.merge_fill_frac:.2f} "
+                f"dstall={self.dispatch_stall_s:.2f}s"
+                if self.dispatch_mode else ""
             )
             + f" → {self.bottleneck}] [{phases}]"
         )
@@ -623,6 +659,10 @@ def jobstats_collector(stats: JobStats):
             "job.spill_s": round(stats.spill_s, 6),
             "job.spill_stall_s": round(stats.spill_stall_s, 6),
             "job.spill_bytes": stats.spill_bytes,
+            "job.dispatch_s": round(stats.dispatch_s, 6),
+            "job.dispatch_stall_s": round(stats.dispatch_stall_s, 6),
+            "job.merge_dispatches": stats.merge_dispatches,
+            "job.merge_fill_frac": round(stats.merge_fill_frac, 6),
             "job.scan_wait_s": round(stats.scan_wait_s, 6),
             "job.all_to_all_s": round(stats.all_to_all_s, 6),
             "job.mesh_rounds": stats.mesh_rounds,

@@ -602,6 +602,25 @@ def stats_to_dict(stats) -> dict:
                 round(stats.fold_s / stream_s, 3) if stream_s else None
             ),
         }
+    if stats.merge_dispatches > 0:
+        # Device-merge dispatch plane (ISSUE 13): which plane ran (async /
+        # sync, coalesced or not), dispatch-thread seconds (overlapped
+        # time made visible), router backpressure, dispatch count and the
+        # mean update fill — the raise-cap-vs-threshold evidence the
+        # doctor's merge-dispatch finding reads.
+        d["dispatch_split"] = {
+            "mode": stats.dispatch_mode,
+            "dispatch_s": round(stats.dispatch_s, 6),
+            "stall_s": round(stats.dispatch_stall_s, 6),
+            "dispatches": stats.merge_dispatches,
+            "fill_frac": round(stats.merge_fill_frac, 6),
+            # dispatch seconds overlapped per stream second — >0 on the
+            # async plane means the sync plane would have added that
+            # fraction to the router's wall (the spill write_overlap twin).
+            "dispatch_overlap": (
+                round(stats.dispatch_s / stream_s, 3) if stream_s else None
+            ),
+        }
     if stats.dict_spill_runs or stats.accum_spill_runs or stats.spill_bytes:
         # Binary async spill plane (ISSUE 11): the disk-tier attribution —
         # writer seconds (overlapped with compute), owner stall seconds
@@ -820,6 +839,15 @@ def format_manifest(m: dict) -> str:
                 f"(x{fs['fold_parallelism'] or 0:.2f} parallel, "
                 f"balance {fs['balance'] or 0:.2f}) "
                 f"stall={fs['fold_stall_s']:.3f}s"
+            )
+        dp = s.get("dispatch_split")
+        if dp:
+            lines.append(
+                f"  dispatch split [{dp['mode']}]: "
+                f"dispatch={dp['dispatch_s']:.3f}s "
+                f"stall={dp['stall_s']:.3f}s "
+                f"{dp['dispatches']} merges "
+                f"(fill {dp['fill_frac']:.2f})"
             )
         sp = s.get("spill_split")
         if sp:

@@ -1281,3 +1281,82 @@ def test_blocking_io_in_fold_silent_outside_hot_scopes(tmp_path):
                     f.write(line)
     """)
     assert fired == []
+
+
+# ---------------------------------------------------------------------------
+# Rule 14 device-dispatch-in-consumer (ISSUE 13): the consume/fold hot
+# scopes book no device hop themselves — windows go through the dispatch
+# plane's submit handoff.
+# ---------------------------------------------------------------------------
+
+def test_device_dispatch_fires_on_inline_device_put(tmp_path):
+    fired, report = program_rules_fired(tmp_path, """
+        import jax
+
+        def consume(result, device):
+            jax.device_put(result, device)
+    """)
+    assert fired == ["device-dispatch-in-consumer"]
+    assert "consume" in report.findings[0].message
+
+
+def test_device_dispatch_follows_sync_helpers(tmp_path):
+    # The pre-ISSUE-13 shipped shape: the hop hides one frame down from
+    # the consumer (pack_and_merge called device_put inline).
+    fired, report = program_rules_fired(tmp_path, """
+        import jax
+
+        def pack_and_merge(flat, device):
+            return jax.device_put(flat, device)
+
+        def consume(result, device):
+            pack_and_merge(result, device)
+    """)
+    assert fired == ["device-dispatch-in-consumer"]
+    assert "via" in report.findings[0].message
+
+
+def test_device_dispatch_fires_on_packed_merge_closure(tmp_path):
+    # Invoking a make_packed_merge_fn(...) product inside the consumer is
+    # a device hop even without a visible device_put (reaching defs
+    # resolve the closure's origin through the alias).
+    fired, _ = program_rules_fired(tmp_path, """
+        def consume(state, flat, app, cap):
+            merge_packed = make_packed_merge_fn(app, cap)
+            state, evicted, n = merge_packed(state, flat)
+            return state
+    """)
+    assert fired == ["device-dispatch-in-consumer"]
+
+
+def test_device_dispatch_silent_on_plane_submit(tmp_path):
+    # The sanctioned shape: the router hands the window to the dispatch
+    # plane; frames below submit are the plane's own (its sync mode runs
+    # them inline BY DESIGN — the A/B measurement path).
+    fired, _ = program_rules_fired(tmp_path, """
+        import jax
+
+        class _DispatchPlane:
+            def submit(self, item):
+                self._handle(item)
+
+            def _handle(self, item):
+                flat = self.pack(item)
+                jax.device_put(flat, self.device)
+
+        def consume(self, result):
+            self.dispatch.submit(result)
+    """)
+    assert fired == []
+
+
+def test_device_dispatch_silent_outside_hot_scopes(tmp_path):
+    # The same hop anywhere else (the stream setup, the drain loop of the
+    # plane itself) is not this rule's business.
+    fired, _ = program_rules_fired(tmp_path, """
+        import jax
+
+        def _stream_single(chunk, device):
+            return jax.device_put(chunk, device)
+    """)
+    assert fired == []

@@ -559,7 +559,13 @@ def test_live_doctor_sees_straggler_before_job_end(tmp_path):
         "--poll-retry", "0.05",
     ]
     env = _env()
-    wenv = dict(env, MR_CHAOS="seed=5;slow_scan:w0:6.0")
+    # w1 is paced too (0.3 s/task): since the dispatch plane (ISSUE 13)
+    # a tiny map task completes in single-digit milliseconds, and an
+    # unpaced w1 could swallow EVERY task before w0's first poll landed —
+    # the seeded straggler then never draws a task and the finding it
+    # exists to trigger can never fire. Pacing keeps the schedule from
+    # collapsing while preserving the 20x p50 ratio the doctor flags.
+    wenv = dict(env, MR_CHAOS="seed=5;slow_scan:w0:6.0;slow_scan:w1:0.3")
     coord = subprocess.Popen(
         [sys.executable, "-m", "mapreduce_rust_tpu", "coordinator",
          "--worker-n", "2", "--manifest", str(tmp_path / "manifest.json"),
