@@ -1736,6 +1736,194 @@ def chaos_legs() -> None:
         raise SystemExit(1)
 
 
+def service_leg(k_jobs: int | None = None) -> None:
+    """``bench.py --service-leg``: continuous-traffic throughput of the
+    multi-tenant job service (ISSUE 14). One OS-process service + 2
+    service workers; a stream of K mixed submissions (three distinct
+    (app, corpus) triples cycled, so repeats past the first cycle are
+    cache hits) drives the admission queue; the leg records jobs/minute,
+    queue-wait p95 and the cache hit rate into .bench/history.jsonl —
+    ``doctor trend`` watches jobs/minute (bad = down: the control plane
+    itself got slower). mrcheck runs over the service work root (every
+    job's journal + report) and a violation fails the leg loudly, the
+    --chaos doctrine. Prints ONE JSON line; exit 1 on failure."""
+    import asyncio
+    import shutil
+
+    from mapreduce_rust_tpu.analysis.mrcheck import run_check
+    from mapreduce_rust_tpu.runtime.histogram import Histogram
+
+    k_jobs = k_jobs or int(os.environ.get("BENCH_SERVICE_JOBS", "12"))
+    root = BENCH_DIR / "service"
+    shutil.rmtree(root, ignore_errors=True)
+    corpora = []
+    for ci in range(3):
+        d = root / f"corpus-{ci}"
+        d.mkdir(parents=True)
+        for i, t in enumerate(_CHAOS_TEXTS):
+            # Distinct corpora (distinct digests): a per-corpus marker
+            # token repeated ci+1 times.
+            (d / f"doc-{i}.txt").write_bytes(
+                t + (f"corpusmark{ci} " * (ci + 1)).encode()
+            )
+        corpora.append(str(d))
+    # The mixed stream: three distinct (app, corpus, config) triples —
+    # every submission past the first cycle is an exact repeat and must
+    # hit the result cache.
+    triples = [
+        {"app": "word_count", "input_dir": corpora[0], "reduce_n": 3},
+        {"app": "inverted_index", "input_dir": corpora[1], "reduce_n": 2},
+        {"app": "word_count", "input_dir": corpora[2], "reduce_n": 3},
+    ]
+    port = _free_port()
+    env = _cpu_env()
+    env["PYTHONPATH"] = str(REPO)
+    common = [
+        "--input", corpora[0], "--output", str(root / "out"),
+        "--work", str(root / "work"), "--port", str(port),
+        "--lease-timeout", "5.0", "--lease-check-period", "0.3",
+        "--renew-period", "0.3", "--poll-retry", "0.05",
+    ]
+    svc = subprocess.Popen(
+        [sys.executable, "-m", "mapreduce_rust_tpu", "service",
+         "--max-jobs", "3", *common],
+        env=env, cwd=str(REPO), stderr=subprocess.DEVNULL,
+    )
+    workers = [
+        subprocess.Popen(
+            [sys.executable, "-m", "mapreduce_rust_tpu", "worker",
+             "--service", "--engine", "host", *common],
+            env=env, cwd=str(REPO), stderr=subprocess.DEVNULL,
+        )
+        for _ in range(2)
+    ]
+    result: dict = {
+        "metric": "job service: K mixed submissions, jobs/minute "
+                  "(service+2 workers, host engine, cpu)",
+        "unit": "jobs/min", "k_jobs": k_jobs,
+    }
+    ok = True
+    try:
+        async def drive() -> dict:
+            from mapreduce_rust_tpu.coordinator.server import (
+                CoordinatorClient,
+            )
+
+            client = CoordinatorClient("127.0.0.1", port, timeout_s=15.0)
+            await client.connect(retries=100, delay=0.1, budget_s=20.0)
+            deadline = time.perf_counter() + int(
+                os.environ.get("BENCH_SERVICE_TIMEOUT_S", "300")
+            )
+            jids: list = []
+            states: dict = {}
+
+            async def submit(spec) -> None:
+                res = await client.call("submit_job", spec)
+                if not res.get("ok"):
+                    raise RuntimeError(f"submit rejected: {res}")
+                jids.append(res["job"])
+
+            async def wait_done() -> None:
+                nonlocal states
+                while time.perf_counter() < deadline:
+                    view = await client.call("stats")
+                    states = {j["job"]: j["state"] for j in view["jobs"]}
+                    if all(states.get(j) == "done" for j in jids):
+                        return
+                    await asyncio.sleep(0.2)
+
+            t0 = time.perf_counter()
+            # Wave 1: the three distinct triples — real compute. Wave 2
+            # (after wave 1 settles): every remaining submission repeats
+            # a triple, so the expected cache-hit count is EXACT (K-3) —
+            # a lower number means the cache broke, and the leg fails.
+            for i in range(min(3, k_jobs)):
+                await submit(triples[i % 3])
+            await wait_done()
+            for i in range(3, k_jobs):
+                await submit(triples[i % 3])
+            await wait_done()
+            wall_s = time.perf_counter() - t0
+            view = await client.call("stats")
+            await client.call("shutdown")
+            await client.close()
+            return {"wall_s": wall_s, "states": states, "view": view}
+
+        out = asyncio.run(drive())
+        states = out["states"]
+        completed = sum(1 for j in states.values() if j == "done")
+        ok = completed == k_jobs
+        sv = out["view"]["service"]
+        cache = sv["cache"]
+        lookups = cache["hits"] + cache["misses"]
+        qh = Histogram.from_dict(sv["queue_wait_s"])
+        result.update({
+            "value": round(completed / (out["wall_s"] / 60.0), 2),
+            "wall_s": round(out["wall_s"], 3),
+            "completed": completed,
+            "cache_hits": cache["hits"],
+            "cache_hit_rate": (
+                round(cache["hits"] / lookups, 3) if lookups else None
+            ),
+            "queue_wait_p95_s": (
+                round(qh.percentile(0.95) or 0.0, 3) if qh.count else None
+            ),
+        })
+        # The expected hit count is exact: every submission past the
+        # first cycle repeats a triple. A lower number = the cache broke.
+        expected_hits = max(k_jobs - 3, 0)
+        if cache["hits"] < expected_hits:
+            ok = False
+            result["error"] = (
+                f"cache hits {cache['hits']} < expected {expected_hits}"
+            )
+    except Exception as e:
+        ok = False
+        result["error"] = repr(e)
+    finally:
+        for p in [svc, *workers]:
+            if p.poll() is None:
+                try:
+                    p.terminate()
+                    p.wait(timeout=20)
+                except (OSError, subprocess.TimeoutExpired):
+                    p.kill()
+                    p.wait()
+    # mrcheck over the whole service work root (multi-job target): the
+    # leg's conformance oracle — the chaos doctrine applied to the
+    # service plane.
+    try:
+        cdoc = run_check(str(root / "work"))
+        result["mrcheck"] = {
+            "ok": cdoc["ok"],
+            "jobs": cdoc["checked"].get("jobs"),
+            "violations": [
+                f"[{v['code']}] {v['message']}"
+                for v in cdoc["violations"][:6]
+            ],
+        }
+        ok = ok and cdoc["ok"]
+    except Exception as e:  # an uncheckable leg is a failed leg
+        ok = False
+        result["mrcheck"] = {"ok": False, "error": repr(e)}
+    result["ok"] = ok
+    _append_history({
+        "metric": result["metric"],
+        "value": None,  # jobs/min has its own trend series below
+        "unit": "jobs/min",
+        "platform": "cpu",
+        "service_jobs_per_min": result.get("value"),
+        "service_queue_wait_p95_s": result.get("queue_wait_p95_s"),
+        "service_cache_hit_rate": result.get("cache_hit_rate"),
+        "service_k_jobs": k_jobs,
+        "service_mrcheck": result.get("mrcheck"),
+        "error": result.get("error"),
+    })
+    print(json.dumps(result))
+    if not ok:
+        raise SystemExit(1)
+
+
 def main() -> None:
     errors: list[str] = []
     base_gbs = None
@@ -1989,11 +2177,14 @@ def _append_history(result: dict) -> None:
             ),
             "had_errors": bool(result.get("error")),
         }
-        # Chaos rows (bench.py --chaos) carry their scenario fields
-        # verbatim; their "value" stays None so `doctor trend`'s watched
-        # series never mix recovery walls with throughput numbers.
+        # Chaos rows (bench.py --chaos) and service rows (--service-leg)
+        # carry their own fields verbatim; their "value" stays None so
+        # `doctor trend`'s watched series never mix recovery walls with
+        # throughput numbers (service_jobs_per_min is its own watched
+        # series — bad direction: down).
         line.update({
-            k: v for k, v in result.items() if k.startswith("chaos_")
+            k: v for k, v in result.items()
+            if k.startswith(("chaos_", "service_"))
         })
         if result.get("chaos_scenario"):
             line["doctor_findings"] = [
@@ -2156,13 +2347,26 @@ if __name__ == "__main__":
         # path, same enablement pattern as --sync-spill.
         os.environ["MR_DISPATCH_SYNC"] = "1"
     _chaos = _take_switch(_argv, "--chaos")
+    _service_leg = _take_switch(_argv, "--service-leg")
     _sweep = _take_flag(_argv, "--sweep-host-workers")
     _sweep_fold = _take_flag(_argv, "--sweep-fold-shards")
     _sweep_spill = _take_flag(_argv, "--sweep-spill-budget")
     _sweep_fill = _take_flag(_argv, "--sweep-dispatch-fill")
     _dispatch_ab = _take_switch(_argv, "--dispatch-ab")
     sys.argv = [sys.argv[0]] + _argv
-    if _chaos:
+    if _service_leg:
+        try:
+            service_leg()
+        except SystemExit:
+            raise
+        except BaseException as e:  # one JSON line, like the main harness
+            print(json.dumps({
+                "metric": "job service: K mixed submissions, jobs/minute",
+                "unit": "jobs/min", "ok": False, "value": None,
+                "error": f"service-leg harness: {e!r}",
+            }))
+            raise SystemExit(1)
+    elif _chaos:
         try:
             chaos_legs()
         except SystemExit:

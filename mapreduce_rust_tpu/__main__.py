@@ -5,6 +5,9 @@ src/clean.sh), as subcommands of one module:
     python -m mapreduce_rust_tpu run         # single-process driver (TPU path)
     python -m mapreduce_rust_tpu coordinator # control plane (multi-process)
     python -m mapreduce_rust_tpu worker      # pull-based worker process
+    python -m mapreduce_rust_tpu service     # long-lived multi-job service
+    python -m mapreduce_rust_tpu submit      # submit a job to the service
+    python -m mapreduce_rust_tpu jobs        # service queue/running/done view
     python -m mapreduce_rust_tpu merge       # mr-*.txt → final.txt
     python -m mapreduce_rust_tpu clean       # rm intermediates/outputs
     python -m mapreduce_rust_tpu doctor      # automated run diagnosis
@@ -151,6 +154,22 @@ def _cfg(args, map_n: int = 1, worker_n: int = 1) -> Config:
         poll_retry_s=getattr(args, "poll_retry", 1.0),
         speculate=getattr(args, "speculate", False),
         speculate_after_frac=getattr(args, "speculate_after_frac", 0.75),
+        # No `or` fallbacks anywhere here: an explicit invalid 0 must hit
+        # Config's validation error, never be silently remapped to the
+        # default (the --dispatch-fill 0 bug class, PR 11 review).
+        service_max_jobs=(
+            args.max_jobs
+            if getattr(args, "max_jobs", None) is not None else 3
+        ),
+        service_inflight_budget_mb=(
+            args.inflight_budget_mb
+            if getattr(args, "inflight_budget_mb", None) is not None
+            else 256.0
+        ),
+        service_cache_entries=(
+            args.cache_entries
+            if getattr(args, "cache_entries", None) is not None else 64
+        ),
         metrics_enabled=not getattr(args, "no_metrics", False),
         metrics_sample_period_s=getattr(args, "metrics_period", 1.0) or 1.0,
         metrics_ring_points=getattr(args, "metrics_ring", 512) or 512,
@@ -229,15 +248,154 @@ def cmd_coordinator(args) -> int:
 
 def cmd_worker(args) -> int:
     from mapreduce_rust_tpu.runtime.chunker import list_inputs
-    from mapreduce_rust_tpu.worker.runtime import Worker
+    from mapreduce_rust_tpu.worker.runtime import ServiceWorker, Worker
 
     _arm_crash_dump(args)
     inputs = list_inputs(args.input, args.pattern)
-    cfg = _cfg(args, map_n=len(inputs))
-    worker = Worker(cfg, app=_app(args), engine=args.engine)
+    if getattr(args, "service", False):
+        # Multi-job fleet member (ISSUE 14): app/inputs/dirs arrive
+        # per-job from the service's job_spec RPC — the CLI's --app/
+        # --input only seed the idle baseline config, so an empty input
+        # dir is fine here (map_n clamps) where the classic worker below
+        # must keep failing loudly on it.
+        cfg = _cfg(args, map_n=max(len(inputs), 1))
+        worker = ServiceWorker(cfg, engine=args.engine)
+    else:
+        cfg = _cfg(args, map_n=len(inputs))
+        worker = Worker(cfg, app=_app(args), engine=args.engine)
     _arm_worker_drain(worker)
     asyncio.run(worker.run())
     return 0
+
+
+def cmd_service(args) -> int:
+    """Long-lived multi-job service (ISSUE 14): job submission RPCs, N
+    concurrent jobs over a shared worker fleet, admission control,
+    result cache, graceful drain. SIGTERM = drain (stop admitting,
+    finish running jobs, journal the queue for restart)."""
+    import signal
+
+    from mapreduce_rust_tpu.service.server import JobService
+
+    _arm_crash_dump(args)
+    cfg = _cfg(args, map_n=1)
+    svc = JobService(cfg)
+
+    async def go() -> None:
+        loop = asyncio.get_running_loop()
+        try:
+            loop.add_signal_handler(signal.SIGTERM, svc.request_drain)
+        except (NotImplementedError, RuntimeError):
+            pass  # non-Unix / nested loop: drain stays reachable via RPC
+        await svc.serve()
+
+    asyncio.run(go())
+    return 0
+
+
+def _service_spec(args) -> dict:
+    """Job spec from the submit CLI's flags — the submit_job payload."""
+    app_args: dict = {}
+    if args.app == "top_k":
+        app_args["k"] = args.k
+    elif args.app == "grep":
+        app_args["query"] = [w for w in args.query.split(",") if w]
+    return {
+        "app": args.app,
+        "app_args": app_args,
+        "input_dir": args.input,
+        "input_pattern": args.pattern,
+        "reduce_n": args.reduce_n,
+    }
+
+
+def cmd_submit(args) -> int:
+    """``submit``: one job into a running service. Prints the submission
+    result as one JSON line; ``--wait`` polls job_status until the job
+    settles (done/failed/cancelled) and prints the final status too.
+    Exit 0 = submitted (and, with --wait, completed), 1 = rejected or
+    failed, 2 = no service."""
+    import json
+
+    from mapreduce_rust_tpu.coordinator.server import (
+        CoordinatorClient,
+        RpcTimeout,
+    )
+
+    spec = _service_spec(args)
+
+    async def go() -> int:
+        client = CoordinatorClient(args.host, args.port, timeout_s=10.0)
+        try:
+            await client.connect(retries=args.connect_retries, delay=0.2)
+        except (OSError, RpcTimeout) as e:
+            print(f"submit: no service at {args.host}:{args.port} ({e})",
+                  file=sys.stderr)
+            return 2
+        try:
+            res = await client.call("submit_job", spec, args.priority)
+            print(json.dumps(res, sort_keys=True), flush=True)
+            if not isinstance(res, dict) or not res.get("ok"):
+                return 1
+            if not args.wait:
+                return 0
+            jid = res["job"]
+            deadline = (
+                asyncio.get_running_loop().time() + args.wait_timeout
+            )
+            while True:
+                st = await client.call("job_status", jid)
+                state = st.get("state") if isinstance(st, dict) else None
+                if state in ("done", "failed", "cancelled"):
+                    print(json.dumps(st, sort_keys=True), flush=True)
+                    return 0 if state == "done" else 1
+                if asyncio.get_running_loop().time() > deadline:
+                    print(f"submit: {jid} still {state} after "
+                          f"{args.wait_timeout}s", file=sys.stderr)
+                    return 1
+                await asyncio.sleep(args.interval)
+        except (ConnectionError, RpcTimeout) as e:
+            print(f"submit: service went away ({e})", file=sys.stderr)
+            return 2
+        finally:
+            await client.close()
+
+    return asyncio.run(go())
+
+
+def cmd_jobs(args) -> int:
+    """``jobs``: the service-wide queue/running/done table (one
+    ``list_jobs`` call; ``--json`` prints the raw RPC response)."""
+    import json
+
+    from mapreduce_rust_tpu.coordinator.server import (
+        CoordinatorClient,
+        RpcTimeout,
+    )
+    from mapreduce_rust_tpu.runtime.telemetry import format_jobs
+
+    async def go() -> int:
+        client = CoordinatorClient(args.host, args.port, timeout_s=10.0)
+        try:
+            await client.connect(retries=args.connect_retries, delay=0.2)
+        except (OSError, RpcTimeout) as e:
+            print(f"jobs: no service at {args.host}:{args.port} ({e})",
+                  file=sys.stderr)
+            return 1
+        try:
+            view = await client.call("list_jobs")
+        except (ConnectionError, RpcTimeout) as e:
+            print(f"jobs: service went away ({e})", file=sys.stderr)
+            return 1
+        finally:
+            await client.close()
+        if getattr(args, "json", False):
+            print(json.dumps(view, sort_keys=True))
+        else:
+            print(format_jobs(view))
+        return 0
+
+    return asyncio.run(go())
 
 
 def _arm_worker_drain(worker) -> None:
@@ -380,7 +538,9 @@ def cmd_watch(args) -> int:
     import time as _time
 
     from mapreduce_rust_tpu.coordinator.server import CoordinatorClient, RpcTimeout
-    from mapreduce_rust_tpu.runtime.telemetry import format_progress
+    from mapreduce_rust_tpu.runtime.telemetry import format_jobs, format_progress
+
+    job = getattr(args, "job", None)
 
     async def go() -> int:
         client = CoordinatorClient(
@@ -394,10 +554,32 @@ def cmd_watch(args) -> int:
             return 1
         as_json = getattr(args, "json", False)
         clear = sys.stdout.isatty() and not args.once and not as_json
+        # Against a JobService: --job <id> polls that job's status (the
+        # coordinator stats shape — the classic renderer applies);
+        # without an id the service-wide queue/running/done table
+        # renders. A pre-service coordinator answers "unknown method" to
+        # the probe and the classic stats loop takes over (ISSUE 14).
+        service_mode = False
+        if job is None:
+            try:
+                await client.call("list_jobs")
+                service_mode = True
+            except RuntimeError as e:
+                if "unknown method" not in str(e):
+                    raise
+            except (ConnectionError, RpcTimeout):
+                print("watch: coordinator gone — job finished or stopped")
+                await client.close()
+                return 0
         try:
             while True:
                 try:
-                    rep = await client.call("stats")
+                    if job is not None:
+                        rep = await client.call("job_status", job)
+                    elif service_mode:
+                        rep = await client.call("list_jobs")
+                    else:
+                        rep = await client.call("stats")
                     live = (
                         await client.call("metrics")
                         if getattr(args, "doctor", False) else None
@@ -413,6 +595,15 @@ def cmd_watch(args) -> int:
                     if isinstance(e, RuntimeError):
                         if "unknown method" not in str(e):
                             raise
+                        if job is not None:
+                            # --job against a pre-service coordinator:
+                            # there is no job_status RPC to poll — error
+                            # out once, never spin on the unknown-method
+                            # reply.
+                            print("watch: coordinator has no job_status "
+                                  "RPC — not a job service (drop --job)",
+                                  file=sys.stderr)
+                            return 2
                         # --doctor against a pre-metrics coordinator:
                         # degrade to the plain view, loudly once.
                         print("watch: coordinator predates the metrics RPC "
@@ -421,6 +612,10 @@ def cmd_watch(args) -> int:
                         continue
                     print("watch: coordinator gone — job finished or stopped")
                     return 0
+                if job is not None and isinstance(rep, dict) \
+                        and rep.get("ok") is False:
+                    print(f"watch: {rep.get('error')}", file=sys.stderr)
+                    return 2
                 if as_json:
                     # One NDJSON object per poll: everything the TUI
                     # renders, machine-readable for external tooling.
@@ -429,14 +624,32 @@ def cmd_watch(args) -> int:
                         row["metrics"] = live
                     print(json.dumps(row, sort_keys=True), flush=True)
                 else:
-                    text = format_progress(rep)
+                    if service_mode and job is None:
+                        text = format_jobs(rep)
+                    elif job is not None and "progress" not in rep:
+                        # Queued/cached/done service job: no live
+                        # coordinator state to render — the summary row
+                        # says everything.
+                        text = json.dumps(rep, sort_keys=True, indent=2)
+                    else:
+                        text = (f"job {job} [{rep.get('state')}]\n"
+                                if job is not None else "") \
+                            + format_progress(rep)
                     if live is not None:
                         from mapreduce_rust_tpu.analysis.doctor import format_live
 
                         text += "\n" + format_live(live, rep)
                     print(("\x1b[H\x1b[2J" + text) if clear else text,
                           flush=True)
-                if args.once or (rep.get("progress") or {}).get("done"):
+                if job is not None:
+                    done = rep.get("state") in ("done", "failed",
+                                                "cancelled")
+                elif service_mode:
+                    sv = rep.get("service") or {}
+                    done = sv.get("draining") and not sv.get("running")
+                else:
+                    done = (rep.get("progress") or {}).get("done")
+                if args.once or done:
                     return 0
                 await asyncio.sleep(args.interval)
         finally:
@@ -588,6 +801,76 @@ def main(argv: list[str] | None = None) -> int:
     p = sub.add_parser("worker", help="pull-based worker process")
     _add_common(p)
     p.add_argument("--engine", default="host", choices=["host", "device"])
+    p.add_argument("--service", action="store_true",
+                   help="join a multi-job service fleet: pull job-tagged "
+                   "tasks across every running job (app/inputs/dirs come "
+                   "per-job from the service's job_spec RPC; --app/--input "
+                   "here only seed the idle baseline)")
+
+    p = sub.add_parser(
+        "service",
+        help="long-lived multi-job service: submission queue, N "
+        "concurrent jobs over one worker fleet, admission control, "
+        "result cache, graceful drain (ISSUE 14)",
+    )
+    _add_common(p)
+    p.add_argument("--max-jobs", type=int, default=3, dest="max_jobs",
+                   help="concurrent RUNNING jobs; further submissions "
+                   "queue FIFO-within-priority (default 3)")
+    p.add_argument("--inflight-budget-mb", type=float, default=256.0,
+                   dest="inflight_budget_mb",
+                   help="admission budget: total input MB across running "
+                   "jobs — a job that would exceed it stays queued "
+                   "(backpressure; the live doctor reports "
+                   "service-saturated). Default 256")
+    p.add_argument("--cache-entries", type=int, default=64,
+                   dest="cache_entries",
+                   help="result-cache capacity (LRU, keyed on app + "
+                   "corpus digest + config digest; 0 = off). A repeated "
+                   "identical submission is served from cache with zero "
+                   "new task grants. Default 64")
+    p.add_argument("--metrics-port", type=int, default=0,
+                   dest="metrics_port",
+                   help="Prometheus endpoint (GET /metrics) with per-job "
+                   "job=<id> labels on phase gauges; 0 (default) = off")
+    p.add_argument("--speculate", action="store_true",
+                   help="per-job speculative re-execution (the single-job "
+                   "coordinator flag, applied to every admitted job)")
+    p.add_argument("--speculate-after-frac", type=float, default=0.75,
+                   dest="speculate_after_frac",
+                   help="fraction of a phase done before speculation arms")
+
+    p = sub.add_parser(
+        "submit",
+        help="submit one job to a running service (prints the job id; "
+        "--wait polls until it settles)",
+    )
+    _add_common(p)
+    p.add_argument("--priority", type=int, default=0,
+                   help="admission priority (higher admits first; FIFO "
+                   "within a priority). Default 0")
+    p.add_argument("--wait", action="store_true",
+                   help="poll job_status until done/failed/cancelled and "
+                   "print the final status (exit 0 only on done)")
+    p.add_argument("--wait-timeout", type=float, default=600.0,
+                   dest="wait_timeout",
+                   help="--wait deadline in seconds (default 600)")
+    p.add_argument("--interval", type=float, default=0.5,
+                   help="--wait poll period in seconds (default 0.5)")
+    p.add_argument("--connect-retries", type=int, default=5,
+                   dest="connect_retries")
+
+    p = sub.add_parser(
+        "jobs",
+        help="service-wide queue/running/done table (one list_jobs call)",
+    )
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=1040)
+    p.add_argument("--json", action="store_true",
+                   help="print the raw list_jobs RPC response")
+    p.add_argument("--connect-retries", type=int, default=5,
+                   dest="connect_retries")
+    p.add_argument("-v", "--verbose", action="store_true")
 
     p = sub.add_parser("merge", help="merge mr-*.txt into final.txt")
     _add_common(p)
@@ -672,6 +955,10 @@ def main(argv: list[str] | None = None) -> int:
                    help="streaming doctor against a RUNNING coordinator: "
                    "poll its stats+metrics RPCs and print findings as "
                    "they first appear, until the job completes")
+    p.add_argument("--job", default=None, metavar="ID",
+                   help="with --live against a multi-job service: stream "
+                   "ONE job's view (its job_status RPC; findings filtered "
+                   "to that job plus the service-plane codes)")
     p.add_argument("--interval", type=float, default=1.0,
                    help="--live poll period in seconds (default 1.0)")
     p.add_argument("--once", action="store_true",
@@ -736,6 +1023,11 @@ def main(argv: list[str] | None = None) -> int:
     )
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=1040)
+    p.add_argument("--job", default=None, metavar="ID",
+                   help="against a multi-job service: watch ONE job "
+                   "(its job_status view); without it a service renders "
+                   "the queue/running/done table instead of single-job "
+                   "progress")
     p.add_argument("--interval", type=float, default=1.0,
                    help="poll period in seconds (default 1 Hz)")
     p.add_argument("--once", action="store_true",
@@ -765,6 +1057,9 @@ def main(argv: list[str] | None = None) -> int:
         "run": cmd_run,
         "coordinator": cmd_coordinator,
         "worker": cmd_worker,
+        "service": cmd_service,
+        "submit": cmd_submit,
+        "jobs": cmd_jobs,
         "merge": cmd_merge,
         "clean": cmd_clean,
         "stats": cmd_stats,

@@ -612,6 +612,87 @@ def diagnose_live(stats_rpc: dict, lease_timeout_s: "float | None" = None,
     return diag
 
 
+def fold_live_findings(registry: dict, findings: list, now: float,
+                       prefix: str = "", on_new=None) -> set:
+    """Fold one tick's findings into a live-findings registry (key →
+    finding with first/last-seen stamps + active flag) — the streaming
+    doctor's dedup, shared by the Coordinator's tick and the
+    JobService's (which prefixes per-job keys). ``on_new(key, finding)``
+    fires exactly once per key's first appearance (the callers' log +
+    trace-instant hook). Returns the keys seen THIS tick; after folding
+    every source, pass their union to :func:`deactivate_stale_findings`
+    — a finding kept with its first_seen is history, not a gauge: a
+    straggler that recovered still happened."""
+    current: set = set()
+    for f in findings or []:
+        key = prefix + (f.get("key") or f["code"])
+        current.add(key)
+        known = registry.get(key)
+        if known is None:
+            registry[key] = {
+                **f, "key": key,
+                "first_seen_s": now, "last_seen_s": now, "active": True,
+            }
+            if on_new is not None:
+                on_new(key, f)
+        else:
+            known.update({
+                "message": f["message"], "severity": f["severity"],
+                "last_seen_s": now, "active": True,
+            })
+    return current
+
+
+def deactivate_stale_findings(registry: dict, current: set) -> None:
+    for key, f in registry.items():
+        if key not in current:
+            f["active"] = False
+
+
+def service_findings(service: "dict | None") -> list:
+    """Live findings of the multi-tenant service plane (ISSUE 14) over a
+    JobService ``service_summary()`` dict — evaluated by the service's
+    doctor tick beside the per-job diagnose_live passes. The headline
+    finding is ``service-saturated``: the admission budget is holding
+    queued jobs back while jobs already run — by design (backpressure,
+    not a fault), but an operator watching the queue back up needs the
+    doctor to say WHY and which knob to turn."""
+    if not isinstance(service, dict):
+        return []
+    findings: list[dict] = []
+    queued = service.get("queued") or 0
+    if queued and service.get("admission_blocked"):
+        inflight = service.get("inflight_bytes") or 0
+        budget = service.get("budget_bytes") or 0
+        findings.append({
+            "severity": "warn", "code": "service-saturated",
+            "key": "service-saturated",
+            "message": (
+                # MiB, matching the service_inflight_budget_mb knob's
+                # unit (budget_bytes = mb << 20) — an operator must be
+                # able to copy the displayed number back into the flag.
+                f"admission blocked: {inflight / (1 << 20):.1f} MB in "
+                f"flight of a {budget / (1 << 20):.1f} MB budget with "
+                f"{queued} job(s) queued "
+                f"({service.get('running', 0)} running) — backpressure is "
+                "working; raise service_inflight_budget_mb / "
+                "service_max_jobs or add workers to drain faster"
+            ),
+        })
+    elif queued and (service.get("running") or 0) \
+            >= (service.get("max_jobs") or 1):
+        findings.append({
+            "severity": "info", "code": "service-queue",
+            "key": "service-queue",
+            "message": (
+                f"{queued} job(s) queued behind the "
+                f"service_max_jobs={service.get('max_jobs')} concurrency "
+                "cap"
+            ),
+        })
+    return findings
+
+
 def format_live(metrics_rpc: dict, stats_rpc: "dict | None" = None) -> str:
     """Plain-text view of the coordinator ``metrics`` RPC — the streaming
     findings (first-seen stamps, live/cleared state) and the fleet's
@@ -666,6 +747,10 @@ def run_live_cli(args) -> int:
         return 2
     interval = getattr(args, "interval", None) or 1.0
     once = bool(getattr(args, "once", False))
+    # ``--job <id>`` (ISSUE 14): against a JobService, stream ONE job's
+    # view — its stats come from the job_status RPC and the service's
+    # findings are filtered to that job's key prefix.
+    job = getattr(args, "job", None)
 
     async def go() -> int:
         client = CoordinatorClient(host, port,
@@ -679,7 +764,8 @@ def run_live_cli(args) -> int:
         try:
             while True:
                 try:
-                    rep = await client.call("stats")
+                    rep = await client.call("job_status", job) if job \
+                        else await client.call("stats")
                     live = await client.call("metrics")
                 except RpcTimeout as e:
                     print(f"doctor --live: coordinator not answering ({e})")
@@ -694,6 +780,21 @@ def run_live_cli(args) -> int:
                         return 2
                     print("doctor --live: coordinator gone — job finished")
                     return 0
+                if job and isinstance(rep, dict) and rep.get("ok") is False:
+                    print(f"doctor --live: {rep.get('error')}")
+                    return 2
+                if job:
+                    # Per-job filter: the service prefixes per-job finding
+                    # keys with "<jid>:" (service-plane findings like
+                    # service-saturated stay visible — they affect every
+                    # job).
+                    live = dict(live)
+                    live["findings"] = [
+                        f for f in live.get("findings") or []
+                        if f.get("job") == job
+                        or str(f.get("key", "")).startswith(f"{job}:")
+                        or str(f.get("code", "")).startswith("service-")
+                    ]
                 if getattr(args, "format", "text") == "json":
                     print(json.dumps({"stats": rep, "metrics": live},
                                      sort_keys=True), flush=True)
@@ -707,7 +808,8 @@ def run_live_cli(args) -> int:
                                 f"[{f['severity'].upper():<5}] "
                                 f"{f['code']}: {f['message']}", flush=True,
                             )
-                done = (rep.get("progress") or {}).get("done")
+                done = rep.get("state") in ("done", "cancelled", "failed") \
+                    if job else (rep.get("progress") or {}).get("done")
                 if once or done:
                     if getattr(args, "format", "text") == "text":
                         print(format_live(live, rep))
@@ -739,6 +841,12 @@ TREND_SERIES: dict[str, str] = {
     # — the coalesce factor eroding (a vocabulary shift, a threshold
     # regression) long before the wall number moves.
     "merge_fill_frac": "down",
+    # Job-service throughput (ISSUE 14): the bench service leg's
+    # jobs-per-minute over a fixed mixed-submission stream. Drifting DOWN
+    # means the control plane itself (admission, dispatch, per-job
+    # bookkeeping) got slower — the regression class a single-job wall
+    # number can never see.
+    "service_jobs_per_min": "down",
 }
 
 

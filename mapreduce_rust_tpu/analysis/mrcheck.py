@@ -143,6 +143,12 @@ INVARIANTS: dict[str, tuple[str, str]] = {
         "two journal/report-state writes for one (phase, tid) with no "
         "happens-before path between them",
     ),
+    "grant-across-jobs": (
+        "events+journal",
+        "a lease granted under job A is never renewed/finished/expired "
+        "under job B — job state is strictly per-job (ISSUE 14: the "
+        "multi-tenant service's cross-job misroute class)",
+    ),
 }
 
 
@@ -173,7 +179,7 @@ def _fmt_event(e) -> str:
         return f"journal:{e.get('line', '?')} {e['raw']!r}"
     if "ev" in e:   # report event-log row
         ctx = " ".join(
-            f"{k}={e[k]}" for k in ("phase", "tid", "attempt", "wid")
+            f"{k}={e[k]}" for k in ("job", "phase", "tid", "attempt", "wid")
             if k in e
         )
         return f"event t={e.get('t', '?')}s {e['ev']} {ctx}".rstrip()
@@ -198,6 +204,7 @@ class JournalLine:
     t: "float | None"
     line: int      # 1-based line number in the journal file
     raw: str
+    job: "str | None" = None  # service jobs annotate ``j<id>`` (ISSUE 14)
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -205,8 +212,10 @@ class JournalLine:
 
 def parse_journal(text: str) -> list[JournalLine]:
     """Task-completion lines of a coordinator journal. Annotation fields
-    (``a2 w1 t12.345``) are optional — a pre-annotation journal parses
-    with them None, exactly like ``_replay_journal`` ignores them."""
+    (``a2 w1 t12.345 jj3``) are optional — a pre-annotation journal
+    parses with them None, exactly like ``_replay_journal`` ignores
+    them. The ``j`` annotation is the owning job id of a multi-tenant
+    service's per-job journal."""
     out: list[JournalLine] = []
     lines = text.splitlines()
     if text and not text.endswith("\n") and lines:
@@ -219,7 +228,7 @@ def parse_journal(text: str) -> list[JournalLine]:
             tid = int(parts[1])
         except ValueError:
             continue
-        attempt = wid = t = None
+        attempt = wid = t = job = None
         for p in parts[2:]:
             try:
                 if p.startswith("a"):
@@ -228,9 +237,11 @@ def parse_journal(text: str) -> list[JournalLine]:
                     wid = int(p[1:])
                 elif p.startswith("t"):
                     t = float(p[1:])
+                elif p.startswith("j") and len(p) > 1:
+                    job = p[1:]
             except ValueError:
                 pass  # annotation noise never invalidates the record
-        out.append(JournalLine(parts[0], tid, attempt, wid, t, i, line))
+        out.append(JournalLine(parts[0], tid, attempt, wid, t, i, line, job))
     return out
 
 
@@ -256,9 +267,13 @@ def _validate_report(rep, src: str) -> None:
                 try:
                     int(tid_s)
                 except (TypeError, ValueError):
-                    raise ValueError(
-                        f"{src}: report tasks[{phase!r}] key {tid_s!r} is "
-                        "not a task id") from None
+                    # Multi-job WRITERS (a ServiceWorker's report spans
+                    # every job it served) key task slots "job:tid".
+                    _job, sep, tail = str(tid_s).rpartition(":")
+                    if not (sep and tail.isdigit()):
+                        raise ValueError(
+                            f"{src}: report tasks[{phase!r}] key {tid_s!r} "
+                            "is not a task id") from None
     events = rep.get("events")
     if events is not None and (
             not isinstance(events, list)
@@ -358,17 +373,41 @@ def load_artifacts(target: str, journal: "str | None" = None,
 
 def check_events(events: list) -> list[Violation]:
     """Replay the ordered event log against the protocol model. Every
-    event must be legal in the (phase, tid) machine's current state."""
+    event must be legal in its machine's current state. Machines are
+    keyed ``(job, phase, tid)`` (ISSUE 14): a multi-job service's rows
+    carry a ``job`` field and two jobs' task 0 are DIFFERENT machines —
+    while a continuation event whose job differs from the job holding
+    the (phase, tid) grant is the cross-job misroute the
+    ``grant-across-jobs`` invariant names. Single-job logs (no job
+    field) replay exactly as before: every key shares job None."""
     v: list[Violation] = []
-    lease: dict = {}      # (phase, tid) -> grant event holding the live lease
-    spec_armed: dict = {} # (phase, tid) -> pending speculate event
-    finished: dict = {}   # (phase, tid) -> first (journaling) finish event
-    revoked: dict = {}    # (phase, tid) -> [revoke events]
+    lease: dict = {}      # (job, phase, tid) -> grant event holding the lease
+    spec_armed: dict = {} # (job, phase, tid) -> pending speculate event
+    finished: dict = {}   # (job, phase, tid) -> first (journaling) finish
+    revoked: dict = {}    # (job, phase, tid) -> [revoke events]
     deregistered: dict = {}  # wid -> deregister event
-    granted: set = set()
+    granted: dict = {}    # (job, phase, tid) -> last grant event
+    granted_pt: dict = {} # (phase, tid) -> {job: last grant event}
+
+    def _cross_job(key, pt) -> "dict | None":
+        """The other-job grant a job-mismatched continuation event points
+        at: a live lease on (phase, tid) under a DIFFERENT job wins;
+        any other job's grant is the fallback evidence."""
+        by_job = granted_pt.get(pt) or {}
+        for other_job, g in by_job.items():
+            if other_job != key[0] and (other_job, *pt) in lease:
+                return g
+        for other_job, g in by_job.items():
+            if other_job != key[0]:
+                return g
+        return None
+
     for e in events or []:
         ev = e.get("ev")
-        key = (e.get("phase"), e.get("tid"))
+        job = e.get("job")
+        pt = (e.get("phase"), e.get("tid"))
+        key = (job, *pt)
+        label = f"{pt[0]} {pt[1]}" + (f" [job {job}]" if job else "")
         if ev == "speculate":
             spec_armed[key] = e
         elif ev == "grant":
@@ -376,7 +415,7 @@ def check_events(events: list) -> list[Violation]:
             if wid in deregistered:
                 v.append(Violation(
                     "grant-after-deregister",
-                    f"{key[0]} {key[1]} granted to worker {wid} after it "
+                    f"{label} granted to worker {wid} after it "
                     "deregistered (drained workers are out of the fleet)",
                     [deregistered[wid], e],
                 ))
@@ -385,7 +424,7 @@ def check_events(events: list) -> list[Violation]:
                 if spec is None:
                     v.append(Violation(
                         "grant-over-live-lease",
-                        f"{key[0]} {key[1]} granted while attempt "
+                        f"{label} granted while attempt "
                         f"{lease[key].get('attempt')} still holds a live "
                         "lease (only a speculation may share it)",
                         [lease[key], e],
@@ -394,30 +433,53 @@ def check_events(events: list) -> list[Violation]:
             else:
                 spec_armed.pop(key, None)
                 lease[key] = e
-            granted.add(key)
+            granted[key] = e
+            granted_pt.setdefault(pt, {})[job] = e
         elif ev == "expire":
             if key not in lease:
-                prior = finished.get(key) or e
-                v.append(Violation(
-                    "expire-without-lease",
-                    f"{key[0]} {key[1]} lease expired with no live lease "
-                    "— a forked speculation lease or an expiry after the "
-                    "task finished",
-                    [prior, e],
-                ))
+                other = _cross_job(key, pt)
+                if other is not None:
+                    v.append(Violation(
+                        "grant-across-jobs",
+                        f"{label} lease expired under a job that never "
+                        f"granted it — job {other.get('job')!r} holds "
+                        "(phase, tid): job state misrouted across "
+                        "tenants",
+                        [other, e],
+                    ))
+                else:
+                    prior = finished.get(key) or e
+                    v.append(Violation(
+                        "expire-without-lease",
+                        f"{label} lease expired with no live lease "
+                        "— a forked speculation lease or an expiry after "
+                        "the task finished",
+                        [prior, e],
+                    ))
             lease.pop(key, None)
         elif ev == "finish":
             if key not in granted:
-                v.append(Violation(
-                    "finish-without-grant",
-                    f"{key[0]} {key[1]} reported finished but was never "
-                    "granted in this log",
-                    [e],
-                ))
+                other = _cross_job(key, pt)
+                if other is not None:
+                    v.append(Violation(
+                        "grant-across-jobs",
+                        f"{label} reported finished under a job that "
+                        f"never granted it — job {other.get('job')!r} "
+                        "owns the (phase, tid) lease: a lease granted "
+                        "under job A must never be finished under job B",
+                        [other, e],
+                    ))
+                else:
+                    v.append(Violation(
+                        "finish-without-grant",
+                        f"{label} reported finished but was never "
+                        "granted in this log",
+                        [e],
+                    ))
             if key in finished:
                 v.append(Violation(
                     "double-win",
-                    f"{key[0]} {key[1]} journaled twice — attempt "
+                    f"{label} journaled twice — attempt "
                     f"{finished[key].get('attempt')} already won",
                     [finished[key], e],
                 ))
@@ -426,7 +488,7 @@ def check_events(events: list) -> list[Violation]:
                 for r in revoked.get(key, []):
                     v.append(Violation(
                         "report-after-revoke",
-                        f"{key[0]} {key[1]} accepted a journaling report "
+                        f"{label} accepted a journaling report "
                         "after the attempt was revoked — the winner must "
                         "be decided before any revocation",
                         [r, e],
@@ -446,6 +508,21 @@ def check_events(events: list) -> list[Violation]:
 def check_journal(journal: list, report: "dict | None") -> list[Violation]:
     """Cross-check the journal against the report's per-task view."""
     v: list[Violation] = []
+    # Job-scoped journals (ISSUE 14): every line of a service job's
+    # journal is annotated with the OWNING job id, and the report says
+    # whose report it is — a line claiming another job is a completion
+    # journaled into the wrong tenant's resume state.
+    report_job = (report or {}).get("job")
+    if report_job:
+        for ln in journal or []:
+            if ln.job and ln.job != report_job:
+                v.append(Violation(
+                    "grant-across-jobs",
+                    f"{ln.phase} {ln.tid} journaled under job {ln.job!r} "
+                    f"inside job {report_job!r}'s journal — a completion "
+                    "written into the wrong tenant's resume state",
+                    [ln.to_dict(), {"ev": "report-job", "job": report_job}],
+                ))
     seen: dict = {}
     for ln in journal or []:
         key = (ln.phase, ln.tid)
@@ -634,8 +711,12 @@ def check_trace(events: list, journal: "list | None" = None) -> list[Violation]:
     writes: dict = {}
     for n in nodes:
         args = n.get("args") or {}
-        key = (args.get("phase"), args.get("tid"))
-        if key[0] is None or key[1] is None:
+        # Job-scoped (ISSUE 14): service events carry a ``job`` arg, and
+        # two jobs' writes to their own task 0 are DISJOINT state — only
+        # same-job (phase, tid) pairs can race. Single-job traces have no
+        # job arg; every key shares None, exactly the old behavior.
+        key = (args.get("job"), args.get("phase"), args.get("tid"))
+        if key[1] is None or key[2] is None:
             continue
         if n.get("name") == "coordinator.journal" or (
             n.get("ph") == "f" and not args.get("revoked")
@@ -648,7 +729,9 @@ def check_trace(events: list, journal: "list | None" = None) -> list[Violation]:
                 if not (_happens_before(a, b) or _happens_before(b, a)):
                     v.append(Violation(
                         "write-race",
-                        f"{key[0]} {key[1]}: two journal/report-state "
+                        f"{key[1]} {key[2]}"
+                        + (f" [job {key[0]}]" if key[0] else "")
+                        + ": two journal/report-state "
                         "writes with no happens-before path between them "
                         "(benign under today's idempotence guard, but a "
                         "real race)",
@@ -668,7 +751,10 @@ def check_trace(events: list, journal: "list | None" = None) -> list[Violation]:
         for ln in journal:
             if not ln.attempt:  # 0/None = unattributed (pre-annotation)
                 continue
+            # Service chains carry the job prefix (Coordinator._fid).
             fid = f"{ln.phase}:{ln.tid}:{ln.attempt}"
+            if ln.job:
+                fid = f"{ln.job}:{fid}"
             phs = chains.get(fid)
             # Only chains whose START ("s") is in THIS artifact owe a
             # terminator: the coordinator emits both s and f, so a start
@@ -691,10 +777,103 @@ def check_trace(events: list, journal: "list | None" = None) -> list[Violation]:
 # Driver + CLI
 # ---------------------------------------------------------------------------
 
+def _service_job_dirs(target: str) -> list:
+    """job-* subdirs of a JobService work root that hold checkable
+    artifacts (per-job journal or job report)."""
+    import glob as _glob
+
+    return sorted(
+        d for d in _glob.glob(os.path.join(target, "job-*"))
+        if os.path.isdir(d) and (
+            os.path.exists(os.path.join(d, "coordinator.journal"))
+            or os.path.exists(os.path.join(d, "job_report.json"))
+        )
+    )
+
+
+def _violation_job(x: dict) -> "str | None":
+    """Best-effort job attribution of a trace-pass violation: the job id
+    its offending events carry (event-log rows and journal lines hold it
+    top-level, trace events under args)."""
+    for e in x.get("events") or []:
+        if not isinstance(e, dict):
+            continue
+        job = e.get("job") or (e.get("args") or {}).get("job")
+        if job:
+            return str(job)
+    return None
+
+
+def run_check_service(target: str, job_dirs: list,
+                      trace: "str | None" = None) -> dict:
+    """Multi-job conformance (ISSUE 14): replay every job's artifacts
+    under the SAME invariant catalog — each job dir is one machine set
+    (its rows are job-stamped, so the cross-job invariant stays armed) —
+    and aggregate into one document. A shared service trace is checked
+    ONCE, against the union of every job's journal lines (flow ids and
+    write keys are job-scoped, so chains never alias): per-job re-scans
+    would report each trace violation N times and stamp it with every
+    innocent job's id."""
+    violations: list[dict] = []
+    jobs: dict = {}
+    checked: dict = {"events": 0, "journal_lines": 0, "jobs": len(job_dirs),
+                     "sources": {"service_root": target}}
+    all_journal: list = []
+    for d in job_dirs:
+        jid = os.path.basename(d)[len("job-"):]
+        doc = run_check(d)
+        jobs[jid] = {"ok": doc["ok"],
+                     "violations": len(doc["violations"])}
+        for x in doc["violations"]:
+            violations.append({**x, "job": jid})
+        checked["events"] += doc["checked"]["events"]
+        checked["journal_lines"] += doc["checked"]["journal_lines"]
+        jpath = os.path.join(d, "coordinator.journal")
+        if os.path.exists(jpath):
+            with open(jpath) as f:
+                all_journal.extend(parse_journal(f.read()))
+    if trace:
+        with open(trace) as f:
+            doc = json.load(f)
+        trace_events = doc.get("traceEvents") if isinstance(doc, dict) \
+            else doc
+        if not isinstance(trace_events, list):
+            raise ValueError(f"{trace}: no traceEvents list")
+        for x in check_trace(trace_events, all_journal):
+            row = x.to_dict()
+            job = _violation_job(row)
+            if job is not None:
+                row["job"] = job
+                if job in jobs:
+                    jobs[job]["ok"] = False
+                    jobs[job]["violations"] += 1
+            violations.append(row)
+        checked["trace_events"] = len(trace_events)
+        checked["sources"]["trace"] = trace
+    return {
+        "tool": "mrcheck",
+        "schema": CHECK_SCHEMA,
+        "kind": "service",
+        "ok": not violations,
+        "violations": violations,
+        "invariants": sorted(INVARIANTS),
+        "jobs": jobs,
+        "checked": checked,
+    }
+
+
 def run_check(target: str, trace: "str | None" = None,
               journal: "str | None" = None,
               job_report: "str | None" = None) -> dict:
-    """Full conformance document for one run's artifacts."""
+    """Full conformance document for one run's artifacts. A JobService
+    work root (job-* subdirs, no top-level coordinator.journal) fans out
+    to every job's artifact set — see run_check_service."""
+    if (os.path.isdir(target) and journal is None and job_report is None
+            and not os.path.exists(
+                os.path.join(target, "coordinator.journal"))):
+        job_dirs = _service_job_dirs(target)
+        if job_dirs:
+            return run_check_service(target, job_dirs, trace=trace)
     art = load_artifacts(target, journal=journal, job_report=job_report)
     report = art["report"] or {}
     violations: list[Violation] = []
@@ -772,8 +951,9 @@ def run_cli(args) -> int:
     srcs = ", ".join(f"{k}={v}" for k, v in sorted(c["sources"].items()))
     print(f"mrcheck: {c['events']} event(s), {c['journal_lines']} journal "
           f"line(s)"
+          + (f", {c['jobs']} job(s)" if c.get("jobs") is not None else "")
           + (f", {c['trace_events']} trace event(s)"
-             if c["trace_events"] is not None else "")
+             if c.get("trace_events") is not None else "")
           + f" [{srcs}]")
     for x in doc["violations"]:
         print(Violation(x["code"], x["message"], x["events"]).format())
@@ -969,6 +1149,23 @@ def mutate_journal_without_finish(workdir: str) -> str:
     return "journal-without-finish"
 
 
+def mutate_grant_across_jobs(workdir: str) -> str:
+    """Re-stamp a finish event's ``job`` field to a foreign job id — the
+    cross-job misroute: the (phase, tid) lease was granted under one job
+    and its completion lands under another (ISSUE 14). The grant keeps
+    its own job (None on a single-job recording — still a mismatch: the
+    machines are keyed by job, and a finish arriving under job 'j999'
+    for a lease job None holds fires exactly this invariant)."""
+    path, doc, rep = _report_doc(workdir)
+    events = rep.get("events") or []
+    i, fin = next(
+        (i, e) for i, e in enumerate(events) if e.get("ev") == "finish"
+    )
+    fin["job"] = "j999"
+    _dump_json(path, doc)
+    return "grant-across-jobs"
+
+
 def mutate_finish_without_journal(workdir: str) -> str:
     """Drop a completed task's journal line — a restart would re-run a
     task whose outputs already exist."""
@@ -1003,4 +1200,5 @@ MUTATIONS: dict = {
     "finish-without-journal": (False, mutate_finish_without_journal),
     "missing-terminator": (True, mutate_drop_terminator),
     "write-race": (True, mutate_write_race),
+    "grant-across-jobs": (False, mutate_grant_across_jobs),
 }

@@ -295,6 +295,31 @@ class Config:
                                     # 0 = off. Standard scrapers work
                                     # against a long-lived coordinator.
 
+    # ---- Multi-tenant job service (ISSUE 14) ----
+    service_max_jobs: int = 3       # concurrent RUNNING jobs the service
+                                    # admits; further submissions queue
+                                    # (FIFO within priority). Each running
+                                    # job owns a namespaced work/output
+                                    # dir, journal, lease table and
+                                    # JobReport — the per-job Coordinator
+                                    # state the shared worker fleet pulls
+                                    # tasks from.
+    service_inflight_budget_mb: float = 256.0  # admission-control budget:
+                                    # total input bytes across RUNNING
+                                    # jobs. A job whose corpus would push
+                                    # the sum past this stays QUEUED
+                                    # (backpressure, surfaced as the
+                                    # live doctor's `service-saturated`
+                                    # finding) — except when nothing is
+                                    # running, so one oversized job can
+                                    # never wedge the queue forever.
+    service_cache_entries: int = 64  # result-cache capacity: completed
+                                    # jobs keyed on (app, corpus-digest,
+                                    # config-digest); a repeated identical
+                                    # submission is served from cache with
+                                    # ZERO new task grants. LRU, evictions
+                                    # counted in the metrics registry.
+
     # ---- Active fault tolerance (speculation / chaos / degradation) ----
     speculate: bool = False         # coordinator speculative re-execution:
                                     # near phase end, re-issue the slowest
@@ -388,6 +413,12 @@ class Config:
             raise ValueError("metrics_port must be >= 0 (0 = off)")
         if self.poll_retry_cap_s is not None and self.poll_retry_cap_s <= 0:
             raise ValueError("poll_retry_cap_s must be positive (or None)")
+        if self.service_max_jobs < 1:
+            raise ValueError("service_max_jobs must be >= 1")
+        if self.service_inflight_budget_mb <= 0:
+            raise ValueError("service_inflight_budget_mb must be positive")
+        if self.service_cache_entries < 0:
+            raise ValueError("service_cache_entries must be >= 0 (0 = off)")
         if self.chaos:
             # Fail at config time, not mid-task inside a worker: a typo'd
             # fault spec must be a loud error before any lease is granted.

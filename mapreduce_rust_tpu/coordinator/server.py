@@ -187,6 +187,122 @@ class _Phase:
         return dead
 
 
+def _log_new_finding(key: str, f: dict) -> None:
+    """First-appearance hook for the streaming doctor's fold (shared by
+    the Coordinator and the JobService): stamp the trace and the log."""
+    trace_instant("doctor.finding", code=f["code"], key=key,
+                  severity=f["severity"])
+    log.info("doctor[live] NEW [%s] %s: %s",
+             f["severity"], f["code"], f["message"])
+
+
+def ingest_fleet_sample(registry, fleet: dict, worker_count: int,
+                        uptime_s: float, wid, sample) -> None:
+    """Fold one renewal-envelope sample into a fleet view and a metrics
+    registry (as per-worker labeled gauges, so the scrape endpoint and
+    the ring carry the same series). Defensive by construction: an
+    envelope is remote input — non-numeric values are dropped and the
+    per-sample series count is capped so a confused worker cannot balloon
+    the registry. Shared by the single-job Coordinator and the multi-job
+    JobService (service/server.py): only wids the server actually issued
+    are accepted — the wid is an unauthenticated RPC param, and an
+    arbitrary int per call would grow the fleet map + per-wid gauge
+    label-sets without bound on a long-lived server."""
+    if (
+        sample is None or registry is None
+        or not isinstance(sample, dict)
+        or not isinstance(wid, int)
+        or not (0 <= wid < worker_count)
+    ):
+        return
+    values = sample.get("v")
+    if not isinstance(values, dict):
+        return
+    kept: dict = {}
+    for k, v in list(values.items())[:64]:
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue
+        kept[str(k)] = v
+        try:
+            registry.gauge(str(k)).set(v, wid=str(wid))
+        except ValueError:
+            # Remote-named series colliding with a server-owned
+            # counter/histogram name: keep it in the fleet view, skip
+            # the registry — a confused worker must never crash the
+            # renewal handler (the lease was already renewed).
+            continue
+    fleet[wid] = {
+        "t": sample.get("t"),
+        "age_s": 0.0,  # refreshed at serve time in metrics()
+        "recv_uptime_s": round(uptime_s, 3),
+        "v": kept,
+    }
+
+
+async def rpc_serve_connection(server, reader: asyncio.StreamReader,
+                               writer: asyncio.StreamWriter) -> None:
+    """The newline-delimited JSON-RPC transport loop, shared by the
+    single-job :class:`Coordinator` and the multi-job JobService
+    (service/server.py — same wire format, wider method table).
+    ``server`` provides ``_METHODS`` (the dispatch allowlist), ``report``
+    (server-side RPC latency accounting) and ``_enrich_response(method,
+    req, result, resp)`` (envelope extras: grant attempt numbers, renewal
+    revocation, job routing)."""
+    try:
+        while True:
+            line = await reader.readline()
+            if not line:
+                return
+            req = json.loads(line)
+            method = req.get("method")
+            if method not in server._METHODS:
+                resp = {"id": req.get("id"),
+                        "error": f"unknown method {method!r}"}
+            else:
+                # Server-side RPC latency (dispatch + handler, excluding
+                # socket writes): the server-health number a stats probe
+                # reads instead of timing its own round trips. Per-RPC
+                # spans are control-plane rate (worker polls + renewals),
+                # not data-plane rate — bounded, not per-record.
+                t0 = time.perf_counter()
+                # ``cid`` is the client's per-call id (rpc.send /
+                # rpc.recv instants carry the same one): the span
+                # becomes the server half of a request/response
+                # happens-before pair mrcheck can traverse.
+                span_args = (
+                    {"cid": req["cid"]} if req.get("cid") else {}
+                )
+                with trace_span(f"rpc.{method}", **span_args):
+                    result = getattr(server, method)(*req.get("params", []))
+                server.report.record_rpc(method, time.perf_counter() - t0)
+                # "now" is the NTP-style timestamp ClockSync brackets:
+                # the server's perf_counter — the clock its own trace
+                # timestamps are measured against, which is what lets
+                # `trace merge` rebase worker files onto it.
+                resp = {
+                    "id": req.get("id"),
+                    "result": result,
+                    "now": time.perf_counter(),
+                }
+                server._enrich_response(method, req, result, resp)
+            writer.write(json.dumps(resp).encode() + b"\n")
+            await writer.drain()
+    except (ConnectionResetError, asyncio.IncompleteReadError,
+            json.JSONDecodeError):
+        pass
+    finally:
+        # Full teardown, not just close(): wait_closed() reaps the
+        # transport so a burst of short-lived clients (renewal
+        # connections, probes) can't accumulate half-closed sockets in
+        # the event loop — same leak class as executor teardown
+        # (mrlint: executor-teardown), applied to the RPC plane.
+        writer.close()
+        try:
+            await writer.wait_closed()
+        except (ConnectionResetError, BrokenPipeError, OSError):
+            pass
+
+
 class Coordinator:
     """In-process scheduler state; serve() exposes it over TCP.
 
@@ -200,8 +316,18 @@ class Coordinator:
     completed task instead of from scratch.
     """
 
-    def __init__(self, cfg: Config, resume: bool = True) -> None:
+    def __init__(self, cfg: Config, resume: bool = True,
+                 job_id: "str | None" = None) -> None:
         self.cfg = cfg
+        # Multi-tenant job service (ISSUE 14): when this scheduler is one
+        # job of a JobService, ``job_id`` namespaces everything that would
+        # otherwise collide across co-hosted jobs — journal lines carry a
+        # ``j<id>`` annotation, event-log rows a ``job`` field, and flow
+        # ids a ``<id>:`` prefix (per-job coordinators share ONE process
+        # tracer, and an un-prefixed ``map:0:1`` chain would merge two
+        # jobs' attempts into one). None = the classic single-job
+        # coordinator, wire- and artifact-identical to before.
+        self.job_id = job_id
         self.map = _Phase(cfg.map_n, cfg.lease_timeout_s)
         self.reduce = _Phase(cfg.reduce_n, cfg.lease_timeout_s)
         self.worker_count = 0
@@ -209,7 +335,7 @@ class Coordinator:
         # and task durations per (phase, tid), plus RPC latencies — served
         # over the `stats` RPC and dumped as work_dir/job_report.json at
         # done(). Aggregate counters only (runtime/metrics.py doctrine).
-        self.report = JobReport()
+        self.report = JobReport(job_id=job_id)
         self._flow_finished: set[str] = set()  # flow ids already terminated
         self.drained: set[int] = set()  # wids that deregistered gracefully
         # Live speculation records: (phase, tid) → the original/speculative
@@ -317,16 +443,21 @@ class Coordinator:
         try:
             os.makedirs(self.cfg.work_dir, exist_ok=True)
             fresh = not os.path.exists(self._journal_path)
+            # The ``j<id>`` annotation (service jobs only) is how a
+            # journal stays attributable when job artifacts are read side
+            # by side — mrcheck parses it like a/w/t; replay still reads
+            # only the first two fields.
+            job_suffix = f" j{self.job_id}" if self.job_id else ""
             with open(self._journal_path, "a") as f:
                 if fresh:
                     f.write(self._header() + "\n")
                 f.write(f"{phase_name} {tid} a{attempt} w{wid} "
-                        f"t{self.report.uptime_s():.3f}\n")
+                        f"t{self.report.uptime_s():.3f}{job_suffix}\n")
             # The journal append IS the authoritative (phase, tid) state
             # write: an instant beside the rpc span makes it a node the
             # happens-before race detector can order.
             trace_instant("coordinator.journal", phase=phase_name, tid=tid,
-                          attempt=attempt, wid=wid)
+                          attempt=attempt, wid=wid, **self._job_args())
         except OSError as e:
             log.warning("journal write failed: %s", e)
 
@@ -342,6 +473,20 @@ class Coordinator:
         log.info("worker %d registered (%d/%d)", wid, self.worker_count, self.cfg.worker_n)
         return wid
 
+    def _job_args(self) -> dict:
+        """Trace-event args identifying this scheduler's job — empty for
+        the single-job coordinator, so pre-service traces stay
+        byte-compatible (no ``job: null`` noise in every event)."""
+        return {"job": self.job_id} if self.job_id else {}
+
+    def _fid(self, name: str, tid: int, attempt: int) -> str:
+        """Flow-chain id of one attempt. Service jobs prefix the job id:
+        per-job coordinators share one process tracer, and without the
+        prefix two jobs' ``map:0:1`` chains would merge into one
+        arrow (and one mrcheck write node)."""
+        base = f"{name}:{tid}:{attempt}"
+        return f"{self.job_id}:{base}" if self.job_id else base
+
     def _grant(self, phase: "_Phase", name: str, wid: int = -1) -> int:
         tid = phase.grant()
         if tid == WAIT and self.cfg.speculate:
@@ -353,8 +498,8 @@ class Coordinator:
             # attempt suffix makes a re-execution a SECOND chain.
             trace_flow(
                 "task", "s",
-                f"{name}:{tid}:{self.report.attempts(name, tid)}",
-                phase=name, tid=tid,
+                self._fid(name, tid, self.report.attempts(name, tid)),
+                phase=name, tid=tid, **self._job_args(),
             )
         return tid
 
@@ -405,7 +550,7 @@ class Coordinator:
         }
         self.report.record_speculation(name, best_tid, wid=wid)
         trace_instant("coordinator.speculate", phase=name, tid=best_tid,
-                      attempt=orig_attempt + 1, wid=wid)
+                      attempt=orig_attempt + 1, wid=wid, **self._job_args())
         log.info(
             "speculating %s %d (attempt %d, original running %.2fs) to "
             "worker %d", name, best_tid, orig_attempt + 1, best_age, wid,
@@ -451,45 +596,8 @@ class Coordinator:
         return ok
 
     def _ingest_sample(self, wid, sample) -> None:
-        """Fold one renewal-envelope sample into the fleet view and the
-        registry (as per-worker labeled gauges, so the scrape endpoint and
-        the ring carry the same series). Defensive by construction: an
-        envelope is remote input — non-numeric values are dropped and the
-        per-sample series count is capped so a confused worker cannot
-        balloon the registry."""
-        if (
-            sample is None or self.registry is None
-            or not isinstance(sample, dict)
-            or not isinstance(wid, int)
-            # Only wids this coordinator actually issued: the wid is an
-            # unauthenticated RPC param, and an arbitrary int per call
-            # would grow the fleet map + per-wid gauge label-sets without
-            # bound on a long-lived coordinator.
-            or not (0 <= wid < self.worker_count)
-        ):
-            return
-        values = sample.get("v")
-        if not isinstance(values, dict):
-            return
-        kept: dict = {}
-        for k, v in list(values.items())[:64]:
-            if isinstance(v, bool) or not isinstance(v, (int, float)):
-                continue
-            kept[str(k)] = v
-            try:
-                self.registry.gauge(str(k)).set(v, wid=str(wid))
-            except ValueError:
-                # Remote-named series colliding with a coordinator-owned
-                # counter/histogram name: keep it in the fleet view, skip
-                # the registry — a confused worker must never crash the
-                # renewal handler (the lease was already renewed).
-                continue
-        self.fleet[wid] = {
-            "t": sample.get("t"),
-            "age_s": 0.0,  # refreshed at serve time in metrics()
-            "recv_uptime_s": round(self.report.uptime_s(), 3),
-            "v": kept,
-        }
+        ingest_fleet_sample(self.registry, self.fleet, self.worker_count,
+                            self.report.uptime_s(), wid, sample)
 
     def metrics(self) -> dict:
         """The 10th RPC: the live telemetry view — the coordinator's
@@ -555,13 +663,14 @@ class Coordinator:
                 )
         self.report.record_finish(name, tid, late=not first, wid=wid,
                                   attempt=attempt or None)
-        fid = f"{name}:{tid}:{attempt or self.report.attempts(name, tid)}"
+        fid = self._fid(name, tid, attempt or self.report.attempts(name, tid))
         if fid not in self._flow_finished:
             # Guard the flow chain's single-finish invariant even if two
             # reports name the same attempt (validate_events rejects a
             # chain continuing past its "f").
             self._flow_finished.add(fid)
-            trace_flow("task", "f", fid, phase=name, tid=tid)
+            trace_flow("task", "f", fid, phase=name, tid=tid,
+                       **self._job_args())
         if first:
             self._journal(name, tid, attempt=attempt, wid=wid)
         return done
@@ -719,7 +828,11 @@ class Coordinator:
         appearance is stamped (coordinator uptime) and dropped into the
         trace as an instant, so the merged timeline shows WHEN the
         diagnosis became true — not just that the corpse had it."""
-        from mapreduce_rust_tpu.analysis.doctor import diagnose_live
+        from mapreduce_rust_tpu.analysis.doctor import (
+            deactivate_stale_findings,
+            diagnose_live,
+            fold_live_findings,
+        )
 
         try:
             diag = diagnose_live(
@@ -730,115 +843,53 @@ class Coordinator:
         except Exception as e:  # diagnosis must never wedge the scheduler
             log.warning("live doctor tick failed: %r", e)
             return
-        now = round(self.report.uptime_s(), 3)
-        current: set = set()
-        for f in diag.get("findings") or []:
-            key = f.get("key") or f["code"]
-            current.add(key)
-            known = self._live_findings.get(key)
-            if known is None:
-                self._live_findings[key] = {
-                    **f, "key": key,
-                    "first_seen_s": now, "last_seen_s": now, "active": True,
-                }
-                trace_instant("doctor.finding", code=f["code"], key=key,
-                              severity=f["severity"])
-                log.info("doctor[live] NEW [%s] %s: %s",
-                         f["severity"], f["code"], f["message"])
-            else:
-                known.update({
-                    "message": f["message"], "severity": f["severity"],
-                    "last_seen_s": now, "active": True,
-                })
-        for key, f in self._live_findings.items():
-            if key not in current:
-                f["active"] = False  # kept with first_seen — history, not
-                # a gauge: a straggler that recovered still happened
+        current = fold_live_findings(
+            self._live_findings, diag.get("findings") or [],
+            round(self.report.uptime_s(), 3), on_new=_log_new_finding,
+        )
+        deactivate_stale_findings(self._live_findings, current)
+
+    def _enrich_response(self, method: str, req: dict, result,
+                         resp: dict) -> None:
+        """Response-envelope extras beyond the bare result (the
+        :func:`rpc_serve_connection` hook — the JobService carries its
+        own version of this for job-routed methods)."""
+        if (
+            method in ("get_map_task", "get_reduce_task")
+            and isinstance(result, int) and result >= 0
+        ):
+            # The grant's attempt number rides back so the
+            # worker can stamp its task span into the same
+            # flow chain (still just small integers).
+            phase = "map" if method == "get_map_task" else "reduce"
+            resp["attempt"] = self.report.attempts(phase, result)
+        elif (
+            method in ("renew_map_lease", "renew_reduce_lease")
+            and result is False
+        ):
+            # A failed renewal is one of two very different
+            # things, and the envelope says which: REVOKED —
+            # the task already completed (another attempt won
+            # the race); stop computing, never report. Not
+            # revoked — the lease merely expired but the task
+            # is still wanted; keep computing, a late report
+            # is a genuine completion that may still win.
+            ph = self.map if method == "renew_map_lease" \
+                else self.reduce
+            params = req.get("params") or [None]
+            resp["revoked"] = params[0] in ph.reported
+            if resp["revoked"]:
+                # The renewing attempt just learned it lost
+                # the race — a state transition (→ revoked)
+                # the conformance replay needs on the log.
+                self.report.record_revocation(
+                    "map" if ph is self.map else "reduce",
+                    params[0],
+                    wid=params[1] if len(params) > 1 else None,
+                )
 
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
-        try:
-            while True:
-                line = await reader.readline()
-                if not line:
-                    return
-                req = json.loads(line)
-                method = req.get("method")
-                if method not in self._METHODS:
-                    resp = {"id": req.get("id"), "error": f"unknown method {method!r}"}
-                else:
-                    # Server-side RPC latency (dispatch + handler, excluding
-                    # socket writes): the coordinator-health number a stats
-                    # probe reads instead of timing its own round trips.
-                    # Per-RPC spans are control-plane rate (worker polls +
-                    # renewals), not data-plane rate — bounded, not per-record.
-                    t0 = time.perf_counter()
-                    # ``cid`` is the client's per-call id (rpc.send /
-                    # rpc.recv instants carry the same one): the span
-                    # becomes the server half of a request/response
-                    # happens-before pair mrcheck can traverse.
-                    span_args = (
-                        {"cid": req["cid"]} if req.get("cid") else {}
-                    )
-                    with trace_span(f"rpc.{method}", **span_args):
-                        result = getattr(self, method)(*req.get("params", []))
-                    self.report.record_rpc(method, time.perf_counter() - t0)
-                    # "now" is the NTP-style timestamp ClockSync brackets:
-                    # the coordinator's perf_counter — the clock its own
-                    # trace timestamps are measured against, which is what
-                    # lets `trace merge` rebase worker files onto it.
-                    resp = {
-                        "id": req.get("id"),
-                        "result": result,
-                        "now": time.perf_counter(),
-                    }
-                    if (
-                        method in ("get_map_task", "get_reduce_task")
-                        and isinstance(result, int) and result >= 0
-                    ):
-                        # The grant's attempt number rides back so the
-                        # worker can stamp its task span into the same
-                        # flow chain (still just small integers).
-                        phase = "map" if method == "get_map_task" else "reduce"
-                        resp["attempt"] = self.report.attempts(phase, result)
-                    elif (
-                        method in ("renew_map_lease", "renew_reduce_lease")
-                        and result is False
-                    ):
-                        # A failed renewal is one of two very different
-                        # things, and the envelope says which: REVOKED —
-                        # the task already completed (another attempt won
-                        # the race); stop computing, never report. Not
-                        # revoked — the lease merely expired but the task
-                        # is still wanted; keep computing, a late report
-                        # is a genuine completion that may still win.
-                        ph = self.map if method == "renew_map_lease" \
-                            else self.reduce
-                        params = req.get("params") or [None]
-                        resp["revoked"] = params[0] in ph.reported
-                        if resp["revoked"]:
-                            # The renewing attempt just learned it lost
-                            # the race — a state transition (→ revoked)
-                            # the conformance replay needs on the log.
-                            self.report.record_revocation(
-                                "map" if ph is self.map else "reduce",
-                                params[0],
-                                wid=params[1] if len(params) > 1 else None,
-                            )
-                writer.write(json.dumps(resp).encode() + b"\n")
-                await writer.drain()
-        except (ConnectionResetError, asyncio.IncompleteReadError, json.JSONDecodeError):
-            pass
-        finally:
-            # Full teardown, not just close(): wait_closed() reaps the
-            # transport so a burst of short-lived clients (renewal
-            # connections, probes) can't accumulate half-closed sockets in
-            # the event loop — same leak class as executor teardown
-            # (mrlint: executor-teardown), applied to the RPC plane.
-            writer.close()
-            try:
-                await writer.wait_closed()
-            except (ConnectionResetError, BrokenPipeError, OSError):
-                pass
+        await rpc_serve_connection(self, reader, writer)
 
     async def serve(self) -> None:
         """Listen + poll loop: 1 Hz done() check, detector every

@@ -28,6 +28,17 @@ Two layers share this module:
 No jax import and no backend probe anywhere in this module: the registry
 must be constructible in the coordinator and in ``watch`` — control-plane
 processes that never load a backend.
+
+Job-isolation audit (ISSUE 14). The module-global registry slot
+(``start_metrics``/``active_registry``/``metrics_tick``) is PROCESS
+state, documented as shared: it exists so build_manifest and the
+engine-side ticks of an OS-process driver/worker find "the" registry
+without plumbing. It is last-writer-wins under co-hosting, which is why
+every multi-tenant owner uses an INSTANCE registry instead — the
+coordinator and the JobService construct their own (per-job series are
+``job=<id>``-LABELED on that one instance, never one registry per job),
+and each Worker ships from ``self.registry``. Nothing job-scoped may
+ever live in the global slot.
 """
 
 from __future__ import annotations
@@ -344,6 +355,23 @@ class _Instrument:
     @staticmethod
     def _labelkey(labels: dict) -> tuple:
         return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+    def remove_labels(self, **labels) -> int:
+        """Drop every label-set whose labels INCLUDE the given pairs
+        (``remove_labels(job="j3")`` drops all of j3's series whatever
+        the other labels say). The long-lived-server hygiene hook
+        (ISSUE 14): a multi-tenant registry that only ever adds
+        label-sets grows without bound and keeps exporting a finished
+        tenant's stale last values. Returns the number dropped; already-
+        recorded ring points keep their history (the ring is bounded)."""
+        want = {(k, str(v)) for k, v in labels.items()}
+        with self._registry._lock:
+            victims = [
+                key for key in self._values if want <= set(key)
+            ]
+            for key in victims:
+                del self._values[key]
+        return len(victims)
 
 
 class Counter(_Instrument):

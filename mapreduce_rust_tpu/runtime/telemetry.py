@@ -55,8 +55,23 @@ class JobReport:
     #: overflow is counted, never silent.
     EVENT_CAP = 20000
 
-    def __init__(self) -> None:
-        self._tasks: dict[tuple[str, int], dict] = {}
+    def __init__(self, job_id: "str | None" = None) -> None:
+        # Multi-tenant job service (ISSUE 14): a per-job report carries
+        # its job id on every event-log row, so a combined/multi-job
+        # artifact stays per-job replayable (mrcheck keys its machines by
+        # (job, phase, tid)) and a mis-routed cross-job event is
+        # detectable (the grant-across-jobs invariant). None = the
+        # single-job coordinator's report — rows stay unstamped, exactly
+        # the pre-service wire format.
+        self.job_id = job_id
+        # ``row_job`` is the job stamped onto event ROWS (defaults to the
+        # report identity). A multi-job WRITER — the ServiceWorker, whose
+        # one report spans every job it serves — switches this per job so
+        # its grant/finish rows replay under per-job machines, while its
+        # report identity stays None (the report is the worker's, not any
+        # one job's).
+        self.row_job = job_id
+        self._tasks: dict[tuple, dict] = {}  # (job-dim, phase, tid) → slot
         self._rpc: dict[str, Histogram] = {}
         # The ordered control-plane event log (mrcheck's replay substrate):
         # one row per STATE TRANSITION of the lease/attempt machine —
@@ -81,10 +96,22 @@ class JobReport:
         self._speculation: dict[str, dict] = {}
         self._t0 = time.monotonic()
 
+    def _jdim(self) -> "str | None":
+        """Job dimension of the per-task aggregation: only a MULTI-job
+        writer (row_job switched away from the report identity — the
+        ServiceWorker) splits task slots by job; a per-job coordinator
+        report (job_id == row_job) and the classic single-job world keep
+        plain (phase, tid) slots. Without this a fleet member serving
+        two jobs' task 0 would merge them into one row — grants=2 reads
+        as a re-execution that never happened and the second job's
+        duration is never recorded."""
+        return self.row_job if self.row_job != self.job_id else None
+
     def _task(self, phase: str, tid: int) -> dict:
-        t = self._tasks.get((phase, tid))
+        key = (self._jdim(), phase, tid)
+        t = self._tasks.get(key)
         if t is None:
-            t = self._tasks[(phase, tid)] = {
+            t = self._tasks[key] = {
                 "grants": 0,
                 "speculations": 0,
                 "renewals": 0,
@@ -123,6 +150,8 @@ class JobReport:
             self._events_dropped += 1
             return
         row: dict = {"t": round(time.monotonic() - self._t0, 6), "ev": ev}
+        if self.row_job is not None:
+            row["job"] = self.row_job
         if phase is not None:
             row["phase"] = phase
         if tid is not None:
@@ -139,14 +168,14 @@ class JobReport:
     def attempts(self, phase: str, tid: int) -> int:
         """How many times (phase, tid) has been granted — the attempt
         number of the CURRENT grant, and the suffix of its flow id."""
-        t = self._tasks.get((phase, tid))
+        t = self._tasks.get((self._jdim(), phase, tid))
         return t["grants"] if t is not None else 0
 
     def task_wid(self, phase: str, tid: int) -> "int | None":
         """The worker id of the task's most recent grant (None when the
         grant was anonymous) — the speculation picker's don't-speculate-
         to-the-holder check."""
-        t = self._tasks.get((phase, tid))
+        t = self._tasks.get((self._jdim(), phase, tid))
         return t["wid"] if t is not None else None
 
     def phase_task_p50(self, phase: str, min_count: int = 1) -> "float | None":
@@ -197,13 +226,14 @@ class JobReport:
 
     def phase_expiries(self, phase: str) -> int:
         return sum(
-            t["expiries"] for (p, _tid), t in self._tasks.items() if p == phase
+            t["expiries"]
+            for (_j, p, _tid), t in self._tasks.items() if p == phase
         )
 
     def phase_late_reports(self, phase: str) -> int:
         return sum(
             t["late_reports"]
-            for (p, _tid), t in self._tasks.items()
+            for (_j, p, _tid), t in self._tasks.items()
             if p == phase
         )
 
@@ -234,7 +264,7 @@ class JobReport:
         # Update-only: a renewal for a task this incarnation never granted
         # (a surviving worker's lease after a journal-resume restart) must
         # not fabricate a grants=0/incomplete phantom entry in the report.
-        t = self._tasks.get((phase, tid))
+        t = self._tasks.get((self._jdim(), phase, tid))
         if t is not None:
             t["renewals" if ok else "stale_renewals"] += 1
         w = self._worker(wid)
@@ -252,7 +282,7 @@ class JobReport:
         # incarnation never granted (journal-resume restart) must not
         # fabricate a completed-but-never-granted entry whose duration_s
         # would be null.
-        t = self._tasks.get((phase, tid))
+        t = self._tasks.get((self._jdim(), phase, tid))
         if t is None:
             return
         self.record_event("late_finish" if late else "finish", phase, tid,
@@ -286,11 +316,13 @@ class JobReport:
         if w is not None:
             w["reports"] += 1
 
-    def in_flight(self) -> list[tuple[str, int]]:
-        """(phase, tid) of tasks granted but not yet reported finished —
-        i.e. leases currently held, as this side observed them."""
+    def in_flight(self) -> list[tuple]:
+        """(phase, tid) — or (job, phase, tid) for a multi-job writer's
+        job-split slots — of tasks granted but not yet reported finished:
+        leases currently held, as this side observed them."""
         return [
-            key for key, t in self._tasks.items()
+            key[1:] if key[0] is None else key
+            for key, t in self._tasks.items()
             if t["grants"] > 0 and t["done_s"] is None
         ]
 
@@ -317,13 +349,19 @@ class JobReport:
 
     def to_dict(self) -> dict:
         phases: dict[str, dict] = {}
-        for (phase, tid), t in sorted(self._tasks.items()):
+        # Multi-job writers' slots render as "job:tid" keys (single-job
+        # and per-job-coordinator reports keep plain tids — the shape
+        # every existing consumer parses).
+        for (jk, phase, tid), t in sorted(
+            self._tasks.items(), key=lambda kv: (kv[0][0] or "", *kv[0][1:])
+        ):
             duration = (
                 round(t["done_s"] - t["first_grant_s"], 6)
                 if t["done_s"] is not None and t["first_grant_s"] is not None
                 else None
             )
-            phases.setdefault(phase, {})[str(tid)] = {
+            tid_key = f"{jk}:{tid}" if jk else str(tid)
+            phases.setdefault(phase, {})[tid_key] = {
                 "grants": t["grants"],
                 "re_executions": max(t["grants"] - 1, 0),
                 "speculations": t["speculations"],
@@ -377,6 +415,8 @@ class JobReport:
         }
         out = {"tasks": phases, "totals": totals, "rpc": rpc,
                "events": self.events()}
+        if self.job_id is not None:
+            out["job"] = self.job_id
         if self._events_dropped:
             out["events_dropped"] = self._events_dropped
         if self._workers:
@@ -474,12 +514,62 @@ def format_progress(stats: dict) -> str:
     return "\n".join(lines)
 
 
-def write_job_report(path: str, report: JobReport) -> str:
+def format_jobs(view: dict) -> str:
+    """Plain-text service-wide queue/running/done table of a JobService
+    ``list_jobs`` RPC response — what ``watch`` (no --job) and the
+    ``jobs`` subcommand render. One row per job, newest done last."""
+    sv = view.get("service") or {}
+    cache = sv.get("cache") or {}
+    lines = [
+        f"service: {sv.get('running', 0)} running · "
+        f"{sv.get('queued', 0)} queued · {sv.get('done', 0)} done · "
+        f"workers {sv.get('workers', 0)}"
+        + (f" ({len(sv['drained'])} drained)" if sv.get("drained") else "")
+        # MiB, matching the service_inflight_budget_mb knob (mb << 20):
+        # the displayed budget must equal the configured number.
+        + f" · inflight {sv.get('inflight_bytes', 0) / (1 << 20):.1f}"
+        f"/{sv.get('budget_bytes', 0) / (1 << 20):.1f} MB"
+        + (" [SATURATED]" if sv.get("admission_blocked") else "")
+        + (" [DRAINING]" if sv.get("draining") else "")
+        + f" · cache {cache.get('hits', 0)}h/{cache.get('misses', 0)}m"
+        f"/{cache.get('entries', 0)}e"
+        + f" · up {sv.get('uptime_s', 0.0):.1f}s"
+    ]
+    rows = view.get("jobs") or []
+    if rows:
+        lines.append(
+            f"  {'JOB':<8} {'STATE':<9} {'APP':<15} {'PRI':>3} "
+            f"{'WAIT':>7} {'RUN':>7}  TASKS"
+        )
+    for j in rows:
+        tasks = j.get("tasks") or {}
+        task_s = " ".join(
+            f"{p} {t.get('done', 0)}/{t.get('total', 0)}"
+            for p, t in sorted(tasks.items())
+        ) or ("cache hit" if j.get("cached") else "-")
+        wait = j.get("queue_wait_s")
+        run = j.get("run_s")
+        lines.append(
+            f"  {j.get('job', '?'):<8} {j.get('state', '?'):<9} "
+            f"{j.get('app', '?'):<15} {j.get('priority', 0):>3} "
+            f"{(f'{wait:.1f}s' if wait is not None else '-'):>7} "
+            f"{(f'{run:.1f}s' if run is not None else '-'):>7}  {task_s}"
+            + (f"  [{j['error']}]" if j.get("error") else "")
+        )
+    return "\n".join(lines)
+
+
+def write_job_report(path: str, report) -> str:
+    """``report`` is a JobReport or an already-snapshotted to_dict()
+    dict — the latter lets a server snapshot ON its event loop (where
+    the report mutates) and ship only the JSON dump + file write to an
+    executor thread (blocking-in-async doctrine)."""
     return write_manifest(path, {
         "schema": MANIFEST_SCHEMA,
         "kind": "job_report",
         "created": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-        "report": report.to_dict(),
+        "report": report.to_dict() if isinstance(report, JobReport)
+        else report,
     })
 
 

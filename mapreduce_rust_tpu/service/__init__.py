@@ -1,0 +1,3 @@
+from mapreduce_rust_tpu.service.server import JobService, validate_spec
+
+__all__ = ["JobService", "validate_spec"]

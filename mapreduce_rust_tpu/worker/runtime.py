@@ -141,6 +141,12 @@ class Worker:
         self.registry = None  # THIS worker's live registry (ISSUE 8);
         # the process-global slot is unreliable under in-process
         # co-hosted workers, so every worker-side tick/ship uses this.
+        # Job-service context (ISSUE 14): the job id of the task currently
+        # being executed. None for the classic single-job worker; the
+        # ServiceWorker sets it per job so task flow-chain ids carry the
+        # same ``<jid>:`` prefix the service-side coordinator emits (two
+        # jobs' ``map:0:1`` chains must never merge into one arrow).
+        self._job_ctx: "str | None" = None
 
     def _metrics_tick(self) -> None:
         """Sampler tick on this worker's own registry (the global
@@ -342,6 +348,15 @@ class Worker:
         if f is not None:
             time.sleep(f.seconds)
 
+    def _task_fid(self, phase: str, tid: int, att: int) -> str:
+        """Flow-chain id of this attempt — job-prefixed under the service
+        (mirrors Coordinator._fid, the other end of the same arrow)."""
+        base = f"{phase}:{tid}:{att}"
+        return f"{self._job_ctx}:{base}" if self._job_ctx else base
+
+    def _job_args(self) -> dict:
+        return {"job": self._job_ctx} if self._job_ctx else {}
+
     def run_map_task(self, tid: int) -> None:
         att = self._attempts.get(("map", tid), 1)
         with trace_span("worker.map_task", tid=tid, attempt=att):
@@ -349,7 +364,8 @@ class Worker:
             # ... → finish-report chain; the instant survives in a flight-
             # recorder partial even though the span itself is only recorded
             # at task exit (a SIGKILLed attempt leaves the begin mark).
-            trace_flow("task", "t", f"map:{tid}:{att}", phase="map", tid=tid)
+            trace_flow("task", "t", self._task_fid("map", tid, att),
+                       phase="map", tid=tid, **self._job_args())
             trace_instant("worker.task_begin", phase="map", tid=tid, attempt=att)
             self._chaos_task_entry("map", tid, att)
             self._run_map_task(tid)
@@ -406,7 +422,8 @@ class Worker:
     def run_reduce_task(self, tid: int) -> None:
         att = self._attempts.get(("reduce", tid), 1)
         with trace_span("worker.reduce_task", tid=tid, attempt=att):
-            trace_flow("task", "t", f"reduce:{tid}:{att}", phase="reduce", tid=tid)
+            trace_flow("task", "t", self._task_fid("reduce", tid, att),
+                       phase="reduce", tid=tid, **self._job_args())
             trace_instant("worker.task_begin", phase="reduce", tid=tid, attempt=att)
             self._chaos_task_entry("reduce", tid, att)
             self._run_reduce_task(tid)
@@ -442,6 +459,23 @@ class Worker:
 
     # ---- task loop ----
 
+    async def _main(self, client: CoordinatorClient) -> bool:
+        """The pull loop proper — between registration and teardown.
+        Returns True when the worker exited because a DRAIN was
+        requested. The classic two-phase machine here; the ServiceWorker
+        overrides this with the multi-job loop (same setup/teardown)."""
+        wid = self.worker_id
+        log.info("worker %d: map phase", wid)
+        draining = await self._run_phase(
+            client, "get_map_task", "renew_map_lease",
+            "report_map_task_finish", self.run_map_task)
+        if not draining:
+            log.info("worker %d: reduce phase", wid)
+            draining = await self._run_phase(
+                client, "get_reduce_task", "renew_reduce_lease",
+                "report_reduce_task_finish", self.run_reduce_task)
+        return draining
+
     def _execute_task(self, run_task, tid: int) -> None:
         """Executor-thread task wrapper: per-task data-plane accounting +
         the post-task device-memory sample, from the thread that just ran
@@ -473,7 +507,8 @@ class Worker:
 
     async def _renewal_loop(self, client: CoordinatorClient, method: str,
                             tid: int, stop: asyncio.Event,
-                            revoked: "asyncio.Event | None" = None) -> None:
+                            revoked: "asyncio.Event | None" = None,
+                            job: "str | None" = None) -> None:
         # ``stop`` backs up task cancellation: on Python < 3.12,
         # asyncio.wait_for SWALLOWS a cancel that lands just as its inner
         # future completes (bpo-42130) — with the per-call rpc timeout
@@ -512,8 +547,17 @@ class Worker:
                 # in-process co-hosted workers replace the global, and a
                 # sample shipped under the wrong wid would show every
                 # worker with the last-started worker's stats.
+                # ``job`` (ISSUE 14) is the OUTERMOST trailing arg — a
+                # service renewal always ships 4 params (sample may be
+                # None) so the job id keeps its position; the single-job
+                # wire format below is untouched.
                 reg = self.registry
-                if reg is not None:
+                if job is not None:
+                    ok = await self._call(
+                        client, method, tid, self._wid,
+                        reg.ship_sample() if reg is not None else None, job,
+                    )
+                elif reg is not None:
                     ok = await self._call(client, method, tid, self._wid,
                                           reg.ship_sample())
                 else:
@@ -622,79 +666,95 @@ class Worker:
             # worker's own event log records the same attempt the
             # coordinator's does (mrcheck reads either side uniformly).
             att = client.last_attempt or 1
-            self.report.record_grant(phase, tid, wid=self._wid, attempt=att)
-            self._attempts[(phase, tid)] = att
-            # Separate connection for renewals, like the reference's
-            # spawned renewal task (mrworker.rs:70-94) — but paced.
-            renew_client = CoordinatorClient(
-                self.cfg.host, self.cfg.port,
-                timeout_s=self.cfg.rpc_timeout_s, sync=self.sync,
+            if not await self._execute_granted(client, phase, tid, att,
+                                               renew, report, run_task):
+                return False
+
+    async def _execute_granted(self, client: CoordinatorClient, phase: str,
+                               tid: int, att: int, renew: str, report: str,
+                               run_task, job: "str | None" = None) -> bool:
+        """One granted task end to end — renewal heartbeat on its own
+        connection, compute on the executor, speculation-revocation
+        handling, the chaos finish sites, and the idempotent finish
+        report. Shared by the single-job phase loop and the ServiceWorker
+        (``job`` = the service job id, threaded onto the renewal/report
+        RPCs as the trailing arg). Returns False when the coordinator
+        vanished mid-report — job complete, the caller stops its loop."""
+        self.report.record_grant(phase, tid, wid=self._wid, attempt=att)
+        self._attempts[(phase, tid)] = att
+        fid = self._task_fid(phase, tid, att)
+        # Separate connection for renewals, like the reference's
+        # spawned renewal task (mrworker.rs:70-94) — but paced.
+        renew_client = CoordinatorClient(
+            self.cfg.host, self.cfg.port,
+            timeout_s=self.cfg.rpc_timeout_s, sync=self.sync,
+        )
+        await renew_client.connect()
+        stop_renewal = asyncio.Event()
+        revoked = asyncio.Event()
+        renewal = asyncio.create_task(
+            self._renewal_loop(renew_client, renew, tid, stop_renewal,
+                               revoked, job=job)
+        )
+        try:
+            # Heavy compute off the event loop so renewals keep flowing.
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._execute_task, run_task, tid
             )
-            await renew_client.connect()
-            stop_renewal = asyncio.Event()
-            revoked = asyncio.Event()
-            renewal = asyncio.create_task(
-                self._renewal_loop(renew_client, renew, tid, stop_renewal,
-                                   revoked)
-            )
-            try:
-                # Heavy compute off the event loop so renewals keep flowing.
-                await asyncio.get_running_loop().run_in_executor(
-                    None, self._execute_task, run_task, tid
-                )
-            finally:
-                # Flag first, then cancel: see _renewal_loop on why cancel
-                # alone can be swallowed mid-RPC on Python < 3.12.
-                stop_renewal.set()
-                renewal.cancel()
-                await asyncio.gather(renewal, return_exceptions=True)
-                await renew_client.close()
-            self._sample_memory()
-            if revoked.is_set():
-                # Speculation loser: another attempt already completed and
-                # journaled this task. Terminate OUR flow chain (the lost
-                # race stays visible in the merged timeline) and never
-                # send the finish report — the coordinator-side journal
-                # must hold exactly one line per task.
-                trace_flow("task", "f", f"{phase}:{tid}:{att}",
-                           phase=phase, tid=tid, revoked=True)
-                self.revoked_tasks.append(f"{phase}:{tid}:{att}")
-                log.info("%s %d: dropping finish report (revoked)", phase, tid)
-                maybe_snapshot()
-                continue
-            f = self._chaos_pick("delay_finish", phase=phase, tid=tid,
-                                 attempt=att, wid=self._wid)
-            if f is not None:
-                await asyncio.sleep(f.seconds)
-            if self._chaos_pick("drop_finish", phase=phase, tid=tid,
-                                attempt=att, wid=self._wid) is not None:
-                # The report never leaves this worker: the coordinator
-                # sees only silence, the lease expires, the task re-runs
-                # (atomic spill rewrites keep the rerun bit-identical).
-                log.warning("%s %d: finish report dropped (chaos)", phase, tid)
-            else:
-                try:
-                    await self._call_with_retry(
-                        client, report, tid,
-                        self._attempts.get((phase, tid), 0), self._wid,
-                    )
-                except ConnectionError:
-                    # The coordinator exited while we computed: under
-                    # speculation a revoked loser can outlive the whole
-                    # JOB (another attempt won, every phase closed, the
-                    # coordinator left before our renewal could observe
-                    # the revocation). Our result is unneeded — terminate
-                    # the chain as revoked and end like the poll path.
-                    trace_flow("task", "f", f"{phase}:{tid}:{att}",
-                               phase=phase, tid=tid, revoked=True)
-                    self.revoked_tasks.append(f"{phase}:{tid}:{att}")
-                    log.info("%s %d: coordinator gone before finish report "
-                             "— job complete, dropping it", phase, tid)
-                    return False
-            self.report.record_finish(phase, tid, wid=self._wid,
-                                      attempt=self._attempts.get((phase, tid)))
+        finally:
+            # Flag first, then cancel: see _renewal_loop on why cancel
+            # alone can be swallowed mid-RPC on Python < 3.12.
+            stop_renewal.set()
+            renewal.cancel()
+            await asyncio.gather(renewal, return_exceptions=True)
+            await renew_client.close()
+        self._sample_memory()
+        if revoked.is_set():
+            # Speculation loser: another attempt already completed and
+            # journaled this task. Terminate OUR flow chain (the lost
+            # race stays visible in the merged timeline) and never
+            # send the finish report — the coordinator-side journal
+            # must hold exactly one line per task.
+            trace_flow("task", "f", fid, phase=phase, tid=tid, revoked=True,
+                       **self._job_args())
+            self.revoked_tasks.append(fid)
+            log.info("%s %d: dropping finish report (revoked)", phase, tid)
             maybe_snapshot()
-            self._metrics_tick()
+            return True
+        f = self._chaos_pick("delay_finish", phase=phase, tid=tid,
+                             attempt=att, wid=self._wid)
+        if f is not None:
+            await asyncio.sleep(f.seconds)
+        if self._chaos_pick("drop_finish", phase=phase, tid=tid,
+                            attempt=att, wid=self._wid) is not None:
+            # The report never leaves this worker: the coordinator
+            # sees only silence, the lease expires, the task re-runs
+            # (atomic spill rewrites keep the rerun bit-identical).
+            log.warning("%s %d: finish report dropped (chaos)", phase, tid)
+        else:
+            params = [tid, self._attempts.get((phase, tid), 0), self._wid]
+            if job is not None:
+                params.append(job)
+            try:
+                await self._call_with_retry(client, report, *params)
+            except ConnectionError:
+                # The coordinator exited while we computed: under
+                # speculation a revoked loser can outlive the whole
+                # JOB (another attempt won, every phase closed, the
+                # coordinator left before our renewal could observe
+                # the revocation). Our result is unneeded — terminate
+                # the chain as revoked and end like the poll path.
+                trace_flow("task", "f", fid, phase=phase, tid=tid,
+                           revoked=True, **self._job_args())
+                self.revoked_tasks.append(fid)
+                log.info("%s %d: coordinator gone before finish report "
+                         "— job complete, dropping it", phase, tid)
+                return False
+        self.report.record_finish(phase, tid, wid=self._wid,
+                                  attempt=self._attempts.get((phase, tid)))
+        maybe_snapshot()
+        self._metrics_tick()
+        return True
 
     async def run(self) -> None:
         # The loop thread may not be the thread that CONSTRUCTED this
@@ -739,15 +799,7 @@ class Worker:
                 log.info("coordinator full — exiting")
                 return
             self.worker_id = wid
-            log.info("worker %d: map phase", wid)
-            draining = await self._run_phase(
-                client, "get_map_task", "renew_map_lease",
-                "report_map_task_finish", self.run_map_task)
-            if not draining:
-                log.info("worker %d: reduce phase", wid)
-                draining = await self._run_phase(
-                    client, "get_reduce_task", "renew_reduce_lease",
-                    "report_reduce_task_finish", self.run_reduce_task)
+            draining = await self._main(client)
             if draining:
                 # Graceful drain: the current task is finished and
                 # reported; deregister so watch/progress show DRAINED
@@ -816,3 +868,157 @@ class Worker:
                 # co-hosted worker may own the global slot by now.
                 stop_metrics(registry)
                 self.registry = None
+
+
+class ServiceWorker(Worker):
+    """Multi-job worker for the JobService (ISSUE 14): one registration,
+    then a single ``get_task`` pull across EVERY running job — the grant
+    arrives job-tagged ({job, phase, tid, attempt}) and the worker
+    switches its task context (app, inputs, namespaced work/output dirs,
+    reduce_n) per job from a cached ``job_spec`` fetch. Task execution,
+    renewal heartbeats, speculation revocation, chaos sites and the
+    manifest teardown are the inherited single-job machinery — only the
+    loop shape changes (jobs interleave instead of phases sequencing).
+
+    Per-job-end teardown (ISSUE 14 satellite): switching jobs trims the
+    driver's ``_PACKED_FNS`` jit cache — the PR 11 hook that used to run
+    only at run_job/process end, which a long-lived multi-job worker
+    would otherwise defeat."""
+
+    #: Spec-cache bound: a fleet member that serves thousands of jobs
+    #: over days must not hoard one spec dict per job forever (the
+    #: _PACKED_FNS leak class, applied to the control plane). LRU — a
+    #: dropped spec is just one job_spec RPC away.
+    SPEC_CACHE_MAX = 64
+
+    def __init__(self, cfg: Config, engine: str = "host") -> None:
+        super().__init__(cfg, engine=engine)
+        self._base_cfg = cfg
+        self._specs: "collections.OrderedDict[str, dict]" = \
+            collections.OrderedDict()
+        self._current_job: "str | None" = None
+
+    async def _main(self, client: CoordinatorClient) -> bool:
+        wid = self.worker_id
+        log.info("worker %d: service loop", wid)
+        poll = Backoff(
+            base_s=self.cfg.poll_retry_s,
+            cap_s=self.cfg.effective_poll_retry_cap_s(),
+            jitter=0.25,
+        )
+        while True:
+            if self._drain.is_set():
+                return True  # between tasks: nothing held, nothing owed
+            try:
+                grant = await self._call_with_retry(client, "get_task",
+                                                    self._wid)
+            except ConnectionError:
+                # Service exited (drained) between polls — a clean end.
+                log.info("service gone — exiting")
+                return False
+            if grant == DONE:
+                return False  # drained and empty: the fleet goes home
+            if not isinstance(grant, dict):
+                # WAIT/NOT_READY: nothing grantable across any job.
+                maybe_snapshot()
+                self._metrics_tick()
+                self._sample_memory()
+                await asyncio.sleep(poll.next_delay())
+                continue
+            poll.reset()
+            jid = grant.get("job")
+            phase = grant.get("phase")
+            tid = grant.get("tid")
+            att = int(grant.get("attempt") or 1)
+            if not isinstance(jid, str) or phase not in ("map", "reduce") \
+                    or not isinstance(tid, int):
+                log.warning("malformed grant %r — skipping", grant)
+                await asyncio.sleep(poll.next_delay())
+                continue
+            if not await self._enter_job(client, jid):
+                continue  # job closed between grant and spec fetch
+            is_map = phase == "map"
+            ok = await self._execute_granted(
+                client, phase, tid, att,
+                "renew_map_lease" if is_map else "renew_reduce_lease",
+                "report_map_task_finish" if is_map
+                else "report_reduce_task_finish",
+                self.run_map_task if is_map else self.run_reduce_task,
+                job=jid,
+            )
+            if not ok:
+                return False
+
+    async def _enter_job(self, client: CoordinatorClient, jid: str) -> bool:
+        """Switch the task context to ``jid`` (no-op when already there):
+        fetch + cache its spec, tear down the previous job's jit cache,
+        and swap app/inputs/dirs. False = the job vanished (done or
+        cancelled between the grant and this fetch) — skip the grant; its
+        lease expires server-side."""
+        if jid == self._current_job:
+            return True
+        spec = self._specs.get(jid)
+        if spec is None:
+            try:
+                spec = await self._call_with_retry(client, "job_spec", jid)
+            except ConnectionError:
+                return False
+            if not isinstance(spec, dict) or not spec.get("ok"):
+                log.warning("job %s: spec unavailable (%s) — skipping grant",
+                            jid, (spec or {}).get("error"))
+                await asyncio.sleep(self.cfg.poll_retry_s)
+                return False
+            self._specs[jid] = spec
+            while len(self._specs) > self.SPEC_CACHE_MAX:
+                self._specs.popitem(last=False)
+        else:
+            self._specs.move_to_end(jid)  # LRU: reuse refreshes recency
+        if self._current_job is not None:
+            self._job_teardown()
+        self._apply_spec(spec)
+        self._current_job = jid
+        return True
+
+    def _job_teardown(self) -> None:
+        """Per-job-end teardown: bound the jit packed-merge cache NOW, not
+        at process exit (ISSUE 14 satellite — the PR 11 hook). Lazy on the
+        driver module: a host-engine worker that never compiled anything
+        must not import jax for a cache trim."""
+        drv = sys.modules.get("mapreduce_rust_tpu.runtime.driver")
+        if drv is not None:
+            try:
+                drv.trim_packed_fns()
+            except Exception:  # teardown telemetry must never kill a task
+                pass
+
+    def _apply_spec(self, spec: dict) -> None:
+        import dataclasses
+
+        from mapreduce_rust_tpu.apps import get_app
+        from mapreduce_rust_tpu.runtime.chunker import list_inputs
+
+        kwargs = dict(spec.get("app_args") or {})
+        if spec["app"] == "grep":
+            kwargs["query"] = tuple(kwargs.get("query") or ())
+        if spec["app"] == "top_k" and "k" in kwargs:
+            kwargs["k"] = int(kwargs["k"])
+        self.app = get_app(spec["app"], **kwargs)
+        self.cfg = dataclasses.replace(
+            self._base_cfg,
+            map_n=max(int(spec["map_n"]), 1),
+            reduce_n=int(spec["reduce_n"]),
+            input_dir=spec["input_dir"],
+            input_pattern=spec["input_pattern"],
+            work_dir=spec["work_dir"],
+            output_dir=spec["output_dir"],
+        )
+        self.inputs = list_inputs(spec["input_dir"], spec["input_pattern"])
+        self.work = pathlib.Path(spec["work_dir"])
+        self.out = pathlib.Path(spec["output_dir"])
+        self._job_ctx = spec["job"]
+        # Stamp this job onto the worker's OWN event-log rows too: the
+        # report spans every job this worker serves, and un-stamped rows
+        # would interleave two jobs' (phase, tid) histories under one
+        # machine in mrcheck's replay (report identity stays the
+        # worker's — row_job, not job_id).
+        self.report.row_job = spec["job"]
