@@ -402,6 +402,126 @@ def zipf_ii_leg(target_mb: int, n_docs: int = 8) -> None:
         raise SystemExit(3)
 
 
+def sort_leg(target_mb: int) -> None:
+    """Runs in a subprocess (--sort): GLOBAL SORT over the Zipf corpus
+    (range-partitioned via sampled splitters, ISSUE 15), budgets engaged.
+    The output contract is TeraSort's: the concatenation of mr-{r}.txt in
+    partition order must be EXACTLY sorted() of the corpus token multiset
+    — verified against the generator's ground-truth counts plus a global
+    order sweep (equal counts + non-decreasing sequence == the sorted
+    multiset, no second sort needed). Prints one JSON detail line with
+    wall, partition_bytes skew ratio and the splitter-sample overhead."""
+    import numpy as np
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    print(f"BENCH_DEVICE_READY {platform}", file=sys.stderr, flush=True)
+
+    from mapreduce_rust_tpu.apps import get_app
+    from mapreduce_rust_tpu.runtime.driver import enable_compilation_cache, run_job
+
+    enable_compilation_cache("auto")
+    corpus, counts_p = build_zipf_corpus(target_mb)
+    truth = np.load(counts_p)
+    cfg = _zipf_cfg("sort-work", "sort-out", reduce_n=8)
+    import shutil
+
+    shutil.rmtree(cfg.work_dir, ignore_errors=True)
+    shutil.rmtree(cfg.output_dir, ignore_errors=True)
+    t0 = time.perf_counter()
+    res = run_job(cfg, [str(corpus)], app=get_app("sort"))
+    dt = time.perf_counter() - t0
+    s = res.stats
+    # Streamed oracle: every output line is the fixed-width token
+    # 'w%06x' + newline (8 bytes), so each partition file parses as one
+    # uint8 matrix and the hex ranks decode vectorized. Lexicographic
+    # token order == numeric rank order (fixed-width hex), so the global
+    # order check is one np.diff per file + the partition boundary carry.
+    got = np.zeros(ZIPF_VOCAB, dtype=np.int64)
+    ordered = True
+    prev = -1
+    lines = 0
+    place = np.power(16, np.arange(5, -1, -1, dtype=np.int64))
+    for f in res.output_files:  # run_job returns partition order
+        data = pathlib.Path(f).read_bytes()
+        if not data:
+            continue
+        arr = np.frombuffer(data, dtype=np.uint8).reshape(-1, 8)
+        hexd = arr[:, 1:7].astype(np.int64)
+        hexd = np.where(hexd >= ord("a"), hexd - (ord("a") - 10),
+                        hexd - ord("0"))
+        ranks = (hexd * place).sum(axis=1)
+        if ranks[0] < prev or (len(ranks) > 1 and np.any(np.diff(ranks) < 0)):
+            ordered = False
+        prev = int(ranks[-1])
+        got += np.bincount(ranks, minlength=ZIPF_VOCAB)
+        lines += len(ranks)
+    exact = bool(np.array_equal(got, truth)) and ordered
+    pb = [b for b in s.partition_bytes]
+    mean_pb = (sum(pb) / len(pb)) if pb else 0.0
+    print(json.dumps({
+        "sort": {
+            "bytes": s.bytes_in, "wall_s": round(dt, 3),
+            "platform": platform, "lines": lines,
+            "ordered": ordered, "exact": exact,
+            "distinct": s.distinct_keys,
+            "partition_mode": s.partition_mode,
+            "reduce_n": cfg.reduce_n,
+            "partition_bytes": pb,
+            # max/mean of realized per-partition output bytes: 1.0 =
+            # ideal R-way split — THE splitter-quality number the doctor
+            # scores and `doctor trend` watches (bad = up).
+            "skew": round(max(pb) / mean_pb, 4) if pb and mean_pb else None,
+            "splitter_samples": s.splitter_samples,
+            "splitter_s": round(s.splitter_s, 4),
+            "spills": s.spill_events,
+            "dict_runs": s.dict_spill_runs,
+            "bottleneck": s.bottleneck,
+        }
+    }))
+    if not exact:
+        raise SystemExit(3)
+
+
+def sort_leg_main() -> None:
+    """``bench.py --sort-leg``: the global-sort workload leg (ISSUE 15
+    satellite) as its own harness — Zipf corpus, range partitioning via
+    sampled splitters, outputs verified globally ordered AND oracle-exact
+    vs the generator ground truth inside the subprocess leg. Appends one
+    history row carrying sort_wall_s + sort_skew (both trend-watched,
+    bad = up) and the splitter-sample overhead. Prints ONE JSON line;
+    exit 1 when the leg failed or diverged."""
+    mb = int(os.environ.get("BENCH_SORT_MB", "48"))
+    res, err = _run_device_leg(
+        pathlib.Path(str(mb)),
+        int(os.environ.get("BENCH_SORT_TIMEOUT_S", "420")),
+        _cpu_env(),  # the range-partition plane under test is host-side;
+        # a wedged tunnel must not eat the workload leg
+        init_timeout_s=PROBE_TIMEOUT_S, mode="--sort",
+    )
+    det = (res or {}).get("sort")
+    result: dict = {
+        "metric": f"global sort over {mb}MB Zipf corpus "
+                  "(range-partitioned, sampled splitters)",
+        "unit": "s",
+        "value": None,  # trend's GB/s series must never mix in sort walls
+        "platform": (det or {}).get("platform", "none"),
+        "sort_wall_s": (det or {}).get("wall_s"),
+        "sort_skew": (det or {}).get("skew"),
+        "sort_splitter_s": (det or {}).get("splitter_s"),
+        "sort_splitter_samples": (det or {}).get("splitter_samples"),
+        "sort_lines": (det or {}).get("lines"),
+        "sort_exact": bool((det or {}).get("exact")),
+    }
+    if res is None:
+        result["error"] = err
+    _append_history(result)
+    print(json.dumps(result))
+    if det is None or not det.get("exact"):
+        raise SystemExit(1)
+
+
 def micro_leg() -> None:
     """Runs in a subprocess (--micro): device micro-benchmarks that survive
     even when the end-to-end leg falls back — map-step ms/MB, h2d MB/s,
@@ -1469,7 +1589,7 @@ _CHAOS_TEXTS = [
 
 def _chaos_cluster(name: str, work_root: pathlib.Path, chaos_spec: str | None,
                    speculate: bool, timeout_s: int = 120,
-                   trace: bool = False) -> dict:
+                   trace: bool = False, app: str = "word_count") -> dict:
     """One chaos leg: coordinator + 2 worker OS processes over TCP (the
     REAL binaries — the recovery paths under test live in the real
     renewal/report loops, not a harness reimplementation). Faults ride in
@@ -1490,6 +1610,8 @@ def _chaos_cluster(name: str, work_root: pathlib.Path, chaos_spec: str | None,
     common = [
         "--input", str(docs), "--output", str(leg / "out"),
         "--work", str(leg / "work"), "--port", str(port), "--reduce-n", "3",
+        "--app", app,  # word_count default; the sort kill leg (ISSUE 15)
+        # runs the range-partitioned app through the SAME cluster harness
         "--lease-timeout", "2.0", "--lease-check-period", "0.3",
         "--renew-period", "0.3", "--poll-retry", "0.05",
     ]
@@ -2184,7 +2306,7 @@ def _append_history(result: dict) -> None:
         # series — bad direction: down).
         line.update({
             k: v for k, v in result.items()
-            if k.startswith(("chaos_", "service_"))
+            if k.startswith(("chaos_", "service_", "sort_"))
         })
         if result.get("chaos_scenario"):
             line["doctor_findings"] = [
@@ -2348,13 +2470,26 @@ if __name__ == "__main__":
         os.environ["MR_DISPATCH_SYNC"] = "1"
     _chaos = _take_switch(_argv, "--chaos")
     _service_leg = _take_switch(_argv, "--service-leg")
+    _sort_leg = _take_switch(_argv, "--sort-leg")
     _sweep = _take_flag(_argv, "--sweep-host-workers")
     _sweep_fold = _take_flag(_argv, "--sweep-fold-shards")
     _sweep_spill = _take_flag(_argv, "--sweep-spill-budget")
     _sweep_fill = _take_flag(_argv, "--sweep-dispatch-fill")
     _dispatch_ab = _take_switch(_argv, "--dispatch-ab")
     sys.argv = [sys.argv[0]] + _argv
-    if _service_leg:
+    if _sort_leg:
+        try:
+            sort_leg_main()
+        except SystemExit:
+            raise
+        except BaseException as e:  # one JSON line, like the main harness
+            print(json.dumps({
+                "metric": "global sort over Zipf corpus",
+                "unit": "s", "value": None,
+                "error": f"sort-leg harness: {e!r}",
+            }))
+            raise SystemExit(1)
+    elif _service_leg:
         try:
             service_leg()
         except SystemExit:
@@ -2440,6 +2575,8 @@ if __name__ == "__main__":
         zipf_leg(int(sys.argv[2]))
     elif len(sys.argv) > 1 and sys.argv[1] == "--zipf-ii":
         zipf_ii_leg(int(sys.argv[2]))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--sort":
+        sort_leg(int(sys.argv[2]))
     elif len(sys.argv) > 1 and sys.argv[1] == "--slow-disk-leg":
         slow_disk_leg(sys.argv[2])
     elif len(sys.argv) > 1 and sys.argv[1] == "--slow-dispatch-leg":

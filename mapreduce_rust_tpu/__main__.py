@@ -33,11 +33,15 @@ from mapreduce_rust_tpu.config import Config
 # The app registry import pulls in the jax-importing app modules; keep this
 # module importable without them so pure control-plane/tooling subcommands
 # (lint, stats, clean) start in milliseconds, backend-free.
-_APP_NAMES = ("grep", "inverted_index", "top_k", "word_count")
+_APP_NAMES = ("grep", "inverted_index", "join", "sort", "top_k", "word_count")
 
 
 def _add_common(p: argparse.ArgumentParser) -> None:
-    p.add_argument("--input", default="data", help="input directory")
+    p.add_argument("--input", nargs="+", default=["data"], metavar="DIR",
+                   help="input corpus: one directory (classic), or N "
+                   "named corpora as name=DIR pairs (multi-corpus input "
+                   "API, e.g. --input a=left-dir b=right-dir — join "
+                   "needs exactly two; corpora order is by NAME)")
     p.add_argument("--pattern", default="*.txt")
     p.add_argument("--output", default="mr-out")
     p.add_argument("--work", default="mr-work")
@@ -45,6 +49,13 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--k", type=int, default=20, help="top_k selection size")
     p.add_argument("--query", default="",
                    help="grep: comma-separated words to search for")
+    p.add_argument("--split-samples", type=int, default=512,
+                   dest="split_samples", metavar="N",
+                   help="range apps (sort): tokens the seeded splitter "
+                   "pre-pass samples per input file (runtime/splitter.py; "
+                   "default 512). More samples = flatter range partitions "
+                   "on skewed corpora — the doctor's splitter-quality "
+                   "finding says when to raise it")
     p.add_argument("--reduce-n", type=int, default=4)
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=1040)
@@ -102,6 +113,22 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("-v", "--verbose", action="store_true")
 
 
+def _parse_inputs(args) -> tuple:
+    """``--input`` → (input_dir, input_dirs), turning a malformed
+    multi-corpus spec into an argparse usage error (the --query/--chaos
+    validation pattern)."""
+    from mapreduce_rust_tpu.runtime.chunker import parse_input_spec
+
+    vals = args.input if isinstance(args.input, list) else [args.input]
+    try:
+        return parse_input_spec(vals)
+    except ValueError as e:
+        parser = getattr(args, "_parser", None)
+        if parser is not None:
+            parser.error(str(e))
+        raise
+
+
 def _cfg(args, map_n: int = 1, worker_n: int = 1) -> Config:
     if getattr(args, "sanitize", False):
         # Export the env form too: the env-only checkpoints (native arena
@@ -121,11 +148,13 @@ def _cfg(args, map_n: int = 1, worker_n: int = 1) -> Config:
             if parser is not None:
                 parser.error(str(e))
             raise
+    input_dir, input_dirs = _parse_inputs(args)
     return Config(
         map_n=max(map_n, 1),
         reduce_n=args.reduce_n,
         worker_n=worker_n,
         chunk_bytes=int(args.chunk_mb * (1 << 20)),
+        split_samples=getattr(args, "split_samples", 512),
         device=args.device,
         map_engine=getattr(args, "map_engine", "device"),
         host_map_workers=getattr(args, "host_workers", None),
@@ -175,7 +204,8 @@ def _cfg(args, map_n: int = 1, worker_n: int = 1) -> Config:
         metrics_ring_points=getattr(args, "metrics_ring", 512) or 512,
         metrics_port=getattr(args, "metrics_port", 0) or 0,
         chaos=chaos,
-        input_dir=args.input,
+        input_dir=input_dir,
+        input_dirs=input_dirs,
         input_pattern=args.pattern,
         work_dir=args.work,
         output_dir=args.output,
@@ -221,47 +251,59 @@ def cmd_run(args) -> int:
 
         initialize(args.coordinator, args.num_processes, args.process_id)
 
-    from mapreduce_rust_tpu.runtime.driver import run_job
-    from mapreduce_rust_tpu.runtime.chunker import list_inputs
+    import dataclasses
 
-    inputs = list_inputs(args.input, args.pattern)
-    cfg = _cfg(args, map_n=len(inputs))
-    res = run_job(cfg, inputs, app=_app(args))
+    from mapreduce_rust_tpu.runtime.driver import run_job
+    from mapreduce_rust_tpu.runtime.chunker import resolve_corpora
+
+    cfg = _cfg(args, map_n=1)
+    inputs, bounds, _names = resolve_corpora(cfg)
+    cfg = dataclasses.replace(cfg, map_n=max(len(inputs), 1))
+    res = run_job(cfg, inputs, app=_app(args), corpus_bounds=bounds)
     print(res.stats.summary())
     print(f"outputs: {', '.join(res.output_files)}")
     return 0
 
 
 def cmd_coordinator(args) -> int:
+    import dataclasses
+
     from mapreduce_rust_tpu.coordinator.server import Coordinator
-    from mapreduce_rust_tpu.runtime.chunker import list_inputs
+    from mapreduce_rust_tpu.runtime.chunker import resolve_corpora
 
     _arm_crash_dump(args)
-    inputs = list_inputs(args.input, args.pattern)
+    cfg = _cfg(args, map_n=1, worker_n=args.worker_n)
+    inputs, _bounds, _names = resolve_corpora(cfg)
     if not inputs:
-        print(f"no inputs matching {args.pattern} in {args.input}", file=sys.stderr)
+        dirs = ", ".join(d for _n, d in cfg.corpora())
+        print(f"no inputs matching {args.pattern} in {dirs}", file=sys.stderr)
         return 2
-    cfg = _cfg(args, map_n=len(inputs), worker_n=args.worker_n)
+    cfg = dataclasses.replace(cfg, map_n=len(inputs))
     asyncio.run(Coordinator(cfg).serve())
     return 0
 
 
 def cmd_worker(args) -> int:
-    from mapreduce_rust_tpu.runtime.chunker import list_inputs
+    import dataclasses
+
+    from mapreduce_rust_tpu.runtime.chunker import resolve_corpora
     from mapreduce_rust_tpu.worker.runtime import ServiceWorker, Worker
 
     _arm_crash_dump(args)
-    inputs = list_inputs(args.input, args.pattern)
+    cfg = _cfg(args, map_n=1)
+    inputs, _bounds, _names = resolve_corpora(cfg)
     if getattr(args, "service", False):
         # Multi-job fleet member (ISSUE 14): app/inputs/dirs arrive
         # per-job from the service's job_spec RPC — the CLI's --app/
         # --input only seed the idle baseline config, so an empty input
         # dir is fine here (map_n clamps) where the classic worker below
         # must keep failing loudly on it.
-        cfg = _cfg(args, map_n=max(len(inputs), 1))
+        cfg = dataclasses.replace(cfg, map_n=max(len(inputs), 1))
         worker = ServiceWorker(cfg, engine=args.engine)
     else:
-        cfg = _cfg(args, map_n=len(inputs))
+        # Same clamp the old _cfg(map_n=len(inputs)) applied — a classic
+        # worker against an empty dir registers and exits with the job.
+        cfg = dataclasses.replace(cfg, map_n=max(len(inputs), 1))
         worker = Worker(cfg, app=_app(args), engine=args.engine)
     _arm_worker_drain(worker)
     asyncio.run(worker.run())
@@ -300,13 +342,22 @@ def _service_spec(args) -> dict:
         app_args["k"] = args.k
     elif args.app == "grep":
         app_args["query"] = [w for w in args.query.split(",") if w]
-    return {
+    input_dir, input_dirs = _parse_inputs(args)
+    spec = {
         "app": args.app,
         "app_args": app_args,
-        "input_dir": args.input,
+        "input_dir": input_dir,
         "input_pattern": args.pattern,
         "reduce_n": args.reduce_n,
+        # Output-determining for range apps (splitter derivation input):
+        # rides the spec so the whole fleet samples identically.
+        "split_samples": args.split_samples,
     }
+    if input_dirs:
+        # Multi-corpus submission (ISSUE 15): the ordered (name, dir)
+        # list rides the spec; the service digests every corpus.
+        spec["inputs"] = [[n, d] for n, d in input_dirs]
+    return spec
 
 
 def cmd_submit(args) -> int:

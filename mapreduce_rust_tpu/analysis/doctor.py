@@ -342,7 +342,22 @@ def diagnose(manifest: dict, job_report: "dict | None" = None,
     parts = _skew_score(stats.get("partition_bytes"))
     if parts is not None:
         skew["reduce_partition_bytes"] = parts
-        if parts["score"] and parts["score"] > 2.0 and parts["n"] >= 4:
+        if stats.get("partition_mode") == "range":
+            # Range-partitioned run (sort, ISSUE 15): partition_bytes
+            # measures SPLITTER quality, not hash mixing — the realized
+            # per-partition bytes vs the ideal R-way split. The fix is
+            # the sampler's knob, not reduce_n: more samples per file
+            # flatten the quantile estimate on skewed corpora.
+            if parts["score"] and parts["score"] > 1.5 and parts["n"] >= 2:
+                n_samp = stats.get("splitter_samples") or 0
+                find("warn", "splitter-quality",
+                     f"hottest range partition holds {parts['score']:.1f}x "
+                     f"its fair share of output bytes ({parts['max']} of "
+                     f"ideal {parts['mean']:.0f}) — the {n_samp} sampled "
+                     "keys under-resolved the key distribution; raise "
+                     "--split-samples (Config.split_samples) so the "
+                     "derived splitters track the real quantiles")
+        elif parts["score"] and parts["score"] > 2.0 and parts["n"] >= 4:
             find("warn", "reduce-skew",
                  f"hottest reduce partition holds {parts['score']:.1f}x its "
                  f"fair share of output bytes ({parts['max']} of mean "
@@ -847,6 +862,13 @@ TREND_SERIES: dict[str, str] = {
     # bookkeeping) got slower — the regression class a single-job wall
     # number can never see.
     "service_jobs_per_min": "down",
+    # Workload plane (ISSUE 15): the bench sort leg's wall and its
+    # realized partition-bytes skew ratio. Wall drifting UP is the
+    # range-partitioned path slowing; skew drifting UP is the sampled
+    # splitters degrading (sampler regression, corpus-generator drift) —
+    # each invisible to the hash legs.
+    "sort_wall_s": "up",
+    "sort_skew": "up",
 }
 
 

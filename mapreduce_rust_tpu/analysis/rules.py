@@ -1511,6 +1511,102 @@ class DeviceDispatchInConsumerRule(ProgramRule):
                     )
 
 
+class UnsampledRangePartitionRule(ProgramRule):
+    """Range-partition calls must consume SAMPLER-derived splitters
+    (rule 15).
+
+    The workload plane's global-sort contract (ISSUE 15) has two legs:
+    partition order is key order, and every re-execution derives the SAME
+    splitters. Both die the moment a call site hands
+    ``range_partition``/``bucket_scatter(mode="range")`` an ad-hoc
+    splitter array: a literal (or a name assigned from one) is divorced
+    from the corpus distribution — partitions silently skew — and any
+    non-shared derivation can disagree between a task and its recovery
+    attempt, routing one key to two partitions (the mrcheck-invisible
+    corruption: both attempts "succeed"). Legitimate splitters flow from
+    exactly two places: the shared sampler (runtime/splitter.py —
+    ``derive_splitters``/``corpus_splitters``/``splitters_for_job``) or
+    an app's bound ``.splitters`` attribute, which only
+    ``splitter.prepare_app`` writes. This rule follows the splitters
+    argument through reaching definitions and flags literal-container
+    provenance; values it cannot resolve (parameters, foreign calls)
+    stay silent — precision over recall, per the module doctrine.
+    """
+
+    name = "unsampled-range-partition"
+    summary = "range-partition splitters must come from the shared sampler"
+
+    #: The sampler's producing functions (runtime/splitter.py) — the OK
+    #: provenance, alongside a ``.splitters`` attribute read (bound-app).
+    _SAMPLER_FUNCS = ("derive_splitters", "corpus_splitters",
+                      "splitters_for_job")
+    _RANGE_FUNCS = ("range_partition",)
+
+    def _splitter_arg(self, call: ast.Call) -> "ast.expr | None":
+        """The splitters expression of a range-partition call site."""
+        seg = _last_segment(qualname(call.func))
+        if seg in self._RANGE_FUNCS:
+            kw = _kw(call, "splitters")
+            if kw is not None:
+                return kw
+            return call.args[1] if len(call.args) > 1 else None
+        if seg == "bucket_scatter":
+            mode = _kw(call, "mode")
+            if not (isinstance(mode, ast.Constant) and mode.value == "range"):
+                return None  # hash mode: no splitters to audit
+            return _kw(call, "splitters") or (
+                call.args[4] if len(call.args) > 4 else None
+            )
+        return None
+
+    def _provenance(self, expr) -> "str | None":
+        """"ok" (sampler/bound-app mention), "literal" (container built
+        in place), or None (unresolvable here)."""
+        verdict = None
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Call) and \
+                    _last_segment(qualname(n.func)) in self._SAMPLER_FUNCS:
+                return "ok"
+            if isinstance(n, ast.Attribute) and n.attr == "splitters":
+                return "ok"  # the bound-app seam: prepare_app-written
+            if isinstance(n, (ast.List, ast.Tuple, ast.Set, ast.ListComp)):
+                verdict = "literal"
+        return verdict
+
+    def run_program(self, program):
+        from mapreduce_rust_tpu.analysis.dataflow import origins
+
+        for fu in program.functions:
+            defs = reach = None
+            for call, _target in program.callees(fu):
+                arg = self._splitter_arg(call)
+                if arg is None:
+                    continue
+                prov = self._provenance(arg)
+                if prov is None and isinstance(arg, ast.Name):
+                    if defs is None:
+                        defs, reach = fu.rd
+                    for o in origins(fu.cfg, defs, reach, arg):
+                        p = self._provenance(o) if o is not None else None
+                        if p == "ok":
+                            prov = "ok"
+                            break
+                        if p == "literal":
+                            prov = "literal"
+                if prov != "literal":
+                    continue
+                yield self.finding(
+                    fu.path, call,
+                    "range partition fed ad-hoc literal splitters — "
+                    "partitions then ignore the corpus distribution and "
+                    "a re-executed task may derive DIFFERENT routing "
+                    "than its first attempt; derive them with the shared "
+                    "sampler (runtime/splitter.derive_splitters / "
+                    "splitters_for_job, or the app's prepare_app-bound "
+                    ".splitters)",
+                )
+
+
 ALL_RULES: list[Rule] = [
     StatsOwnershipRule(),
     ExecutorTeardownRule(),
@@ -1535,4 +1631,5 @@ PROGRAM_RULES: list[ProgramRule] = [
     CrossShardFoldRule(),
     BlockingIoInFoldRule(),
     DeviceDispatchInConsumerRule(),
+    UnsampledRangePartitionRule(),
 ]

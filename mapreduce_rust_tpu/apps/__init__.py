@@ -6,6 +6,8 @@ the CLI/driver boundary instead of compile-time-fixed boxed fns
 from mapreduce_rust_tpu.apps.base import App  # noqa: F401
 from mapreduce_rust_tpu.apps.grep import Grep  # noqa: F401
 from mapreduce_rust_tpu.apps.inverted_index import InvertedIndex  # noqa: F401
+from mapreduce_rust_tpu.apps.join import Join  # noqa: F401
+from mapreduce_rust_tpu.apps.sort import Sort  # noqa: F401
 from mapreduce_rust_tpu.apps.top_k import TopK  # noqa: F401
 from mapreduce_rust_tpu.apps.word_count import WordCount  # noqa: F401
 
@@ -14,6 +16,8 @@ REGISTRY: dict[str, type[App]] = {
     "inverted_index": InvertedIndex,
     "top_k": TopK,
     "grep": Grep,
+    "sort": Sort,
+    "join": Join,
 }
 
 

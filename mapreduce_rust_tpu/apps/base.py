@@ -43,6 +43,27 @@ class App:
 
     name: str = "app"
     combine_op: str = "sum"
+    #: Range apps (sort): R−1 packed-uint64 splitters, bound by the
+    #: sampled-splitter subsystem (runtime/splitter.prepare_app) before
+    #: the job streams — NEVER hand-rolled (mrlint rule 15). () = unbound.
+    splitters: tuple = ()
+    #: Multi-corpus apps (join): cumulative doc counts of corpora[:-1] in
+    #: the flat doc_id space, bound by prepare_app. A record's corpus is
+    #: bisect(corpus_bounds, doc_id) — the "which side" signal device_map
+    #: and finalize read. () = single corpus.
+    corpus_bounds: tuple = ()
+
+    #: "hash" routes egress by k1 % reduce_n (the reference's partitioner);
+    #: "range" routes by searchsorted over the bound splitters — partition
+    #: order then IS key order (ops/partition.py). CLASS attribute (no
+    #: annotation — deliberately not a dataclass field): the mode is the
+    #: app's shape, not per-job state, so subclasses override it with a
+    #: bare assignment (sort does).
+    partition_mode = "hash"
+    #: Non-zero → prepare_app enforces exactly this many input corpora
+    #: (join: 2) at bind time, before any lease or chunk. Class
+    #: attribute, like partition_mode.
+    requires_corpora = 0
 
     def device_map(self, kv: KVBatch, doc_id: jnp.ndarray) -> KVBatch:
         """On-device per-chunk transform; doc_id is a traced int32 scalar."""
@@ -78,31 +99,66 @@ class App:
             )
         return counts
 
+    def route(self, word: "bytes | None", k1: int, reduce_n: int) -> int:
+        """Output partition of one key. Hash mode ignores the word —
+        k1 % reduce_n, the reference's partitioner (src/mr/worker.rs:
+        111-115,129); range apps override via the bound splitters (word
+        bytes required: hashes cannot order words). EVERY egress tier
+        routes through this (or its vectorized twin below): the in-RAM
+        finalize, the streaming spill merge-join, and the distributed map
+        task's spill/dict-shard split."""
+        return k1 % reduce_n
+
+    def route_block(self, words, k1s, reduce_n: int):
+        """Vectorized route for the streaming egress (driver
+        _stream_finalize): partition ids for a block of (word, k1) pairs.
+        Must agree with ``route`` element-wise — the two egress tiers'
+        bit-identical-outputs contract depends on it."""
+        import numpy as np
+
+        return (np.asarray(k1s, dtype=np.int64) % reduce_n).tolist()
+
+    def emit_lines(self, word: bytes, value: "FinalValue") -> list[bytes]:
+        """Output lines for ONE final key — the egress emission seam.
+        Default: one 'word value' line. Sort emits the word ``value``
+        times (a global sort's output is the input multiset); join emits
+        one line per cross-product pair and [] for one-sided keys. Both
+        egress tiers (in-RAM and streaming) call exactly this, so an app
+        that only customizes emission never loses the bounded-memory
+        spill path the way a finalize override does."""
+        return [self.format_line(word, value)]
+
     def finalize(
         self, items: Iterable[tuple[bytes, "FinalValue", tuple[int, int]]], reduce_n: int
     ) -> dict[int, list[bytes]]:
         """items: (word, value, key_pair) for every distinct key, unordered.
 
         Returns partition → output lines (no trailing newline). Default:
-        route by k1 % reduce_n — the reference's partitioner
-        (src/mr/worker.rs:111-115,129) — one 'word value' line per key,
-        sorted bytewise within each partition like the reference's
-        sort-then-emit reduce (src/mr/worker.rs:162-184).
+        route via ``self.route`` (hash or range), emit via
+        ``self.emit_lines``, sorted bytewise within each partition like
+        the reference's sort-then-emit reduce (src/mr/worker.rs:162-184).
         """
         parts: dict[int, list[bytes]] = {r: [] for r in range(reduce_n)}
         for word, value, (k1, _k2) in items:
-            parts[k1 % reduce_n].append(self.format_line(word, value))
+            parts[self.route(word, k1, reduce_n)].extend(
+                self.emit_lines(word, value)
+            )
         for lines in parts.values():
             lines.sort()
         return parts
 
     def finalize_partition(self, items: Iterable, partition: int) -> list[bytes]:
         """Egress for ONE reduce partition — the distributed (worker/) path,
-        where each reduce task owns one hash class and emits its own
-        mr-{r}.txt (reference src/mr/worker.rs:167). items as in finalize.
-        Apps needing global selection emit per-partition *candidates* here
-        and finish the job in merge_lines (top_k does)."""
-        return sorted(self.format_line(w, v) for w, v, _ in items)
+        where each reduce task owns one partition class and emits its own
+        mr-{r}.txt (reference src/mr/worker.rs:167). items as in finalize
+        (already routed by the map tasks via ``route``). Apps needing
+        global selection emit per-partition *candidates* here and finish
+        the job in merge_lines (top_k does)."""
+        lines: list[bytes] = []
+        for w, v, _ in items:
+            lines.extend(self.emit_lines(w, v))
+        lines.sort()
+        return lines
 
     def merge_lines(self, lines: Iterable[bytes]) -> list[bytes]:
         """Global merge of all partitions' lines — the reference's

@@ -1,0 +1,66 @@
+"""Two-input equi-join — the workload that forces the multi-corpus input
+API (ISSUE 15).
+
+Join on the token: for every word present in BOTH corpora, emit one line
+per (left doc, right doc) pair containing it. The TPU formulation reuses
+the inverted-index machinery end to end:
+
+- the chunker tags chunks with a corpus id and doc_ids are GLOBAL across
+  the concatenated corpus listings (runtime/chunker.resolve_corpora), so
+  device_map's doc_id stamp — inherited from InvertedIndex, unchanged —
+  already encodes the side: ``doc_id < corpus_bounds[0]`` is the left
+  corpus. No second value lane, no per-record corpus tag on device;
+- combine_op "distinct" builds each word's posting set associatively
+  across chunks/chips — co-partitioning is free because the same word
+  hashes identically from either corpus (hash mode: both sides of a key
+  land in one partition/reduce task by construction);
+- ``emit_lines`` splits the posting set at the bound corpus boundary and
+  emits the cross product with corpus-RELATIVE doc ids ("word aDoc bDoc")
+  — [] for one-sided keys, so they vanish from the output exactly as an
+  inner join must.
+
+``requires_corpora = 2`` makes prepare_app reject any other corpus count
+at bind time (driver and service submission both), before a single chunk
+streams.
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+
+from mapreduce_rust_tpu.apps.inverted_index import InvertedIndex
+
+
+@dataclasses.dataclass(frozen=True)
+class Join(InvertedIndex):
+    """An inverted index whose egress is the inner-join cross product:
+    posting-list building (device_map doc stamp, distinct combine,
+    host_values) is inherited; only emission differs — so join keeps the
+    streaming spill egress and every engine, like sort."""
+
+    name: str = "join"
+    requires_corpora = 2
+
+    def corpus_of(self, doc_id: int) -> int:
+        """Which corpus a global doc_id came from — the generic form any
+        multi-corpus app reads (bisect over the bound cumulative
+        boundaries); join only ever sees two."""
+        return bisect.bisect_right(self.corpus_bounds, doc_id)
+
+    def emit_lines(self, word: bytes, value) -> list[bytes]:
+        bound = self.corpus_bounds[0]
+        left = [d for d in value if d < bound]
+        right = [d - bound for d in value if d >= bound]
+        if not left or not right:
+            return []  # one-sided key: inner join drops it
+        return [
+            b"%s %d %d" % (word, a, b)
+            for a in left for b in right
+        ]
+
+    def format_line(self, word: bytes, value) -> bytes:  # pragma: no cover
+        raise NotImplementedError(
+            "join emits via emit_lines (cross-product pairs), never a "
+            "single posting line"
+        )

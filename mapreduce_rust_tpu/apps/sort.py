@@ -1,0 +1,68 @@
+"""Global sort — the canonical range-partitioned MapReduce workload
+(TeraSort; Coded TeraSort, arXiv:1702.04850), ISSUE 15.
+
+Every other shipped app is commutative-fold shaped and hash-partitioned;
+sort is the workload that exercises the OTHER half of the partitioning
+story. The TPU formulation:
+
+- map/combine is word count (sum of occurrences per token) — the device
+  kernels, host scan, spill planes and mesh shuffle run unchanged;
+- egress routes by RANGE, not hash: partition = searchsorted of the
+  word's packed 8-byte prefix over R−1 splitters the sampled-splitter
+  subsystem derived (runtime/splitter.py) and ``prepare_app`` bound onto
+  this frozen instance before the stream started;
+- ``emit_lines`` emits the word once per occurrence, so the concatenation
+  of ``mr-{r}.txt`` in partition order is EXACTLY ``sorted()`` of the
+  corpus token multiset: range routing orders partitions, the egress
+  tiers' bytewise per-partition sort orders within, and prefix packing is
+  order-preserving (ops/partition.pack_word_prefix) with equal-prefix
+  words always sharing a partition.
+
+Neither finalize nor finalize_partition is overridden — sort keeps the
+bounded-memory streaming egress (spill budgets) and the distributed
+reduce path for free; only route/emit differ from word count.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import numpy as np
+
+from mapreduce_rust_tpu.apps.base import App
+from mapreduce_rust_tpu.ops.partition import pack_word_prefix, range_partition
+
+
+@functools.lru_cache(maxsize=8)
+def _splitter_array(splitters: tuple) -> np.ndarray:
+    """The bound splitter tuple as a frozen uint64 array — cached so the
+    per-block route doesn't rebuild it, frozen so no caller can corrupt
+    the shared copy (the grep _query_keys doctrine)."""
+    arr = np.asarray(splitters, dtype=np.uint64)
+    arr.flags.writeable = False
+    return arr
+
+
+@dataclasses.dataclass(frozen=True)
+class Sort(App):
+    name: str = "sort"
+    combine_op: str = "sum"
+    partition_mode = "range"
+
+    def route(self, word: "bytes | None", k1: int, reduce_n: int) -> int:
+        if word is None:
+            return 0  # unknown-key guard: counted upstream, never crashes
+        return int(range_partition(
+            pack_word_prefix([word]), _splitter_array(self.splitters)
+        )[0])
+
+    def route_block(self, words, k1s, reduce_n: int):
+        return range_partition(
+            pack_word_prefix(words), _splitter_array(self.splitters)
+        ).tolist()
+
+    def emit_lines(self, word: bytes, value) -> list[bytes]:
+        """One line per OCCURRENCE: the sorted output is the input token
+        multiset, the TeraSort contract (records in, records out)."""
+        return [word] * int(value)

@@ -368,9 +368,36 @@ class Config:
                                     # cap keeps it responsive enough to
                                     # claim speculative re-executions.
 
+    # ---- Workload plane (ISSUE 15) ----
+    split_samples: int = 512        # sampled-splitter subsystem
+                                    # (runtime/splitter.py): tokens sampled
+                                    # PER INPUT FILE by the seeded pre-pass
+                                    # that derives range-partition
+                                    # splitters for range apps (sort).
+                                    # More samples = flatter partitions on
+                                    # skewed corpora; the doctor's
+                                    # splitter-quality finding says when
+                                    # to raise it. Deterministic: the seed
+                                    # is fixed (splitter.SPLIT_SEED), so
+                                    # re-executed tasks re-derive
+                                    # bit-identical splitters.
+
     # ---- Paths ----
     input_dir: str = "data"
     input_pattern: str = "*.txt"
+    input_dirs: "Optional[tuple]" = None  # multi-corpus input API
+                                    # (ISSUE 15): ordered ((name, dir),
+                                    # ...) pairs — the CLI's
+                                    # ``--input a=DIR b=DIR`` form,
+                                    # canonically sorted by name. When
+                                    # set it supersedes input_dir; the
+                                    # flat doc_id space concatenates the
+                                    # corpora's sorted listings in this
+                                    # order (chunker.resolve_corpora) and
+                                    # apps see the boundaries via
+                                    # App.corpus_bounds (join needs
+                                    # exactly two). None = the classic
+                                    # single corpus at input_dir.
     work_dir: str = "mr-work"        # intermediates / checkpoints
     output_dir: str = "mr-out"       # final per-partition outputs
 
@@ -419,12 +446,39 @@ class Config:
             raise ValueError("service_inflight_budget_mb must be positive")
         if self.service_cache_entries < 0:
             raise ValueError("service_cache_entries must be >= 0 (0 = off)")
+        if self.split_samples < 1:
+            raise ValueError("split_samples must be >= 1")
+        if self.input_dirs is not None:
+            # Canonical, validated form: a non-empty tuple of (name, dir)
+            # string pairs with unique non-empty names — a malformed
+            # corpus spec must fail at Config time, never as a KeyError
+            # inside a worker's spec fetch.
+            dirs = tuple(tuple(p) for p in self.input_dirs)
+            if not dirs or not all(
+                len(p) == 2 and all(isinstance(x, str) and x for x in p)
+                for p in dirs
+            ):
+                raise ValueError(
+                    "input_dirs must be ((name, dir), ...) string pairs"
+                )
+            names = [n for n, _ in dirs]
+            if len(set(names)) != len(names):
+                raise ValueError(f"duplicate corpus names in {names}")
+            self.input_dirs = dirs
         if self.chaos:
             # Fail at config time, not mid-task inside a worker: a typo'd
             # fault spec must be a loud error before any lease is granted.
             from mapreduce_rust_tpu.analysis.chaos import ChaosPlan
 
             ChaosPlan.parse(self.chaos)
+
+    def corpora(self) -> "tuple[tuple[str, str], ...]":
+        """The job's ordered (name, dir) corpus list — the ONE accessor
+        every consumer (chunker, service, worker) resolves inputs
+        through, multi-corpus or classic."""
+        if self.input_dirs is not None:
+            return tuple(self.input_dirs)
+        return (("corpus", self.input_dir),)
 
     def effective_poll_retry_cap_s(self) -> float:
         return self.poll_retry_cap_s or 4.0 * self.poll_retry_s

@@ -1,12 +1,26 @@
-"""Hash partitioning into fixed-capacity buckets.
+"""Partitioning: hash mode and range mode, one seam (ISSUE 15).
 
 Replaces the reference's file-plane partitioner
 ``write_key_value_to_file`` (src/mr/worker.rs:117-140): there each pair is
 routed by ``DefaultHasher(key) % reduce_n`` (worker.rs:111-115,129) into one
 of ``reduce_n`` files with an awaited write per pair. Here routing is
-``k1 % num_buckets`` computed for the whole batch at once, and "files"
-become rows of a ``[num_buckets, capacity]`` device array — the exact
-layout ``lax.all_to_all`` wants for the ICI shuffle (parallel/shuffle.py).
+computed for the whole batch at once, in one of two modes:
+
+- **hash** — ``k1 % num_buckets``, the reference's semantics. "Files"
+  become rows of a ``[num_buckets, capacity]`` device array — the exact
+  layout ``lax.all_to_all`` wants for the ICI shuffle
+  (parallel/shuffle.py), which routes through :func:`bucket_scatter`.
+- **range** — ``searchsorted`` over R−1 packed-uint64 splitters derived
+  by the sampled-splitter subsystem (runtime/splitter.py). The packed
+  key is the word's big-endian 8-byte prefix (:func:`pack_word_prefix`),
+  which is order-preserving: ``a < b`` bytewise ⇒ ``prefix(a) <=
+  prefix(b)``, so partition order + within-partition bytewise line sort =
+  GLOBAL order across ``mr-{r}.txt`` files (apps/sort.py). The host
+  egress tiers (driver in-RAM finalize AND the spill merge-join) and the
+  distributed map task all route through :func:`range_partition`; the
+  device twin is :func:`bucket_scatter`'s ``mode="range"`` — splitters as
+  uint32 lane PAIRS, because the data plane has no native 64-bit path
+  (core/hashing.py) and jnp.uint64 silently narrows without x64.
 
 XLA needs static shapes, so each bucket has fixed capacity; records beyond
 a bucket's capacity are dropped and *counted* (the driver sizes capacity
@@ -20,26 +34,94 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from mapreduce_rust_tpu.core.hashing import SENTINEL
 from mapreduce_rust_tpu.core.kv import KVBatch
 
+#: The two partition modes an app may declare (apps/base.App.partition_mode).
+PARTITION_MODES = ("hash", "range")
 
-@functools.partial(jax.jit, static_argnames=("num_buckets", "capacity"))
+
+def pack_word_prefix(words) -> np.ndarray:
+    """uint64[n] big-endian first-8-bytes pack of each word — THE
+    order-preserving key of range mode. Zero-padded on the right, so the
+    numeric order of the packed values equals bytewise order of the
+    8-byte prefixes, and bytewise word order is refined within equal
+    prefixes by the per-partition line sort (all equal-prefix words land
+    in ONE partition: searchsorted is constant on equal keys). The math
+    is one vectorized byte-matrix reduction — this runs per 64K-key
+    block of the streaming sort egress, where a per-word int.from_bytes
+    would be the very Python tax the spill plane vectorized away."""
+    n = len(words)
+    if not n:
+        return np.zeros(0, dtype=np.uint64)
+    buf = b"".join(bytes(w[:8]).ljust(8, b"\x00") for w in words)
+    mat = np.frombuffer(buf, dtype=np.uint8).reshape(n, 8).astype(np.uint64)
+    place = np.uint64(1) << (np.uint64(8) * np.arange(7, -1, -1,
+                                                      dtype=np.uint64))
+    return (mat * place).sum(axis=1, dtype=np.uint64)
+
+
+def range_partition(packed: np.ndarray, splitters: np.ndarray) -> np.ndarray:
+    """Partition ids for packed-uint64 keys against sorted splitters:
+    ``searchsorted(splitters, key, side='right')`` — the count of
+    splitters <= key, so R−1 splitters induce R partitions and equal keys
+    always share one partition. The splitters MUST come from the shared
+    sampler (runtime/splitter.derive_splitters) — ad-hoc splitters break
+    the re-execution determinism contract (mrlint rule 15
+    ``unsampled-range-partition``)."""
+    spl = np.asarray(splitters, dtype=np.uint64)
+    return np.searchsorted(spl, np.asarray(packed, dtype=np.uint64),
+                           side="right").astype(np.int64)
+
+
+def splitter_pairs(splitters) -> np.ndarray:
+    """uint32[R-1, 2] lane split of packed-uint64 splitters — the form the
+    device twin (bucket_scatter mode="range") consumes; see the module
+    docstring for why the device never sees a 64-bit lane."""
+    spl = np.asarray(splitters, dtype=np.uint64)
+    hi = (spl >> np.uint64(32)).astype(np.uint32)
+    lo = (spl & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    return np.stack([hi, lo], axis=1)
+
+
+def _range_bucket_ids(k1, k2, pairs) -> jnp.ndarray:
+    """Device-side searchsorted over splitter lane pairs: partition =
+    #splitters <= (k1, k2) lexicographically — exactly range_partition's
+    side='right' on the packed form, without a 64-bit dtype."""
+    s1 = pairs[:, 0][None, :]
+    s2 = pairs[:, 1][None, :]
+    le = (s1 < k1[:, None]) | ((s1 == k1[:, None]) & (s2 <= k2[:, None]))
+    return jnp.sum(le.astype(jnp.int32), axis=1)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_buckets", "capacity", "mode")
+)
 def bucket_scatter(
-    batch: KVBatch, num_buckets: int, capacity: int
+    batch: KVBatch, num_buckets: int, capacity: int, mode: str = "hash",
+    splitters=None,
 ) -> tuple[KVBatch, jnp.ndarray]:
     """Scatter records into bucket-major layout.
 
     Returns (KVBatch with arrays shaped [num_buckets, capacity],
     overflow_count). Invalid records go nowhere; records past a bucket's
-    capacity are dropped into the overflow count.
+    capacity are dropped into the overflow count. ``mode="hash"`` routes
+    by ``k1 % num_buckets`` (the ICI shuffle's state-ownership route);
+    ``mode="range"`` routes by lexicographic searchsorted over
+    ``splitters`` lane pairs (uint32 [num_buckets-1, 2], see
+    splitter_pairs) — the device twin of :func:`range_partition`.
     """
     n = batch.capacity
     nb = jnp.int32(num_buckets)
+    if mode == "range":
+        ids = _range_bucket_ids(batch.k1, batch.k2, jnp.asarray(splitters))
+    else:
+        ids = (batch.k1 % nb.astype(jnp.uint32)).astype(jnp.int32)
     bucket = jnp.where(
         batch.valid,
-        (batch.k1 % nb.astype(jnp.uint32)).astype(jnp.int32),
+        ids,
         jnp.int32(num_buckets),  # invalid → virtual overflow bucket, dropped
     )
 

@@ -144,7 +144,13 @@ def _chip_shuffle_tail(kv: KVBatch, doc_id, app: App, u_cap: int,
         partial = count_unique(mine, op=op)
         update = partial.take_front(u_cap)
         p_ovf = jnp.sum(partial.valid[u_cap:].astype(jnp.int32)) + c_ovf
-        buckets, b_ovf = bucket_scatter(update, num_buckets=d, capacity=bucket_cap)
+        # Shared partition seam (ops/partition.py): the ICI shuffle always
+        # routes state ownership by hash — chip d owns hash class k1 % d.
+        # Range apps (sort) still shuffle by hash here; their RANGE order
+        # is established at host egress, where word bytes exist
+        # (apps/base.App.route_block — hashes alone cannot order words).
+        buckets, b_ovf = bucket_scatter(update, num_buckets=d,
+                                        capacity=bucket_cap, mode="hash")
     with jax.named_scope("shuffle.all_to_all"):
         recv = jax.tree.map(
             lambda x: jax.lax.all_to_all(x, AXIS, split_axis=0, concat_axis=0, tiled=True),

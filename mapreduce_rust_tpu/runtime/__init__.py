@@ -11,6 +11,9 @@ run_job`` still works; it just imports driver at attribute access time.
 _LAZY = {
     "Chunk": "chunker", "chunk_document": "chunker", "chunk_stream": "chunker",
     "iter_chunks": "chunker", "list_inputs": "chunker",
+    "parse_input_spec": "chunker", "resolve_corpora": "chunker",
+    "derive_splitters": "splitter", "prepare_app": "splitter",
+    "splitters_for_job": "splitter",
     "Dictionary": "dictionary", "extract_words": "dictionary",
     "JobResult": "driver", "merge_outputs": "driver", "run_job": "driver",
     "JobStats": "metrics",

@@ -40,11 +40,16 @@ _ASCII_WS = frozenset(WHITESPACE_BYTES)
 class Chunk:
     """One device-ready chunk: uint8[chunk_bytes], space padded."""
 
-    doc_id: int  # input file index
+    doc_id: int  # input file index (GLOBAL across corpora, ISSUE 15)
     seq: int  # chunk index within the document
     data: np.ndarray  # uint8[chunk_bytes]
     nbytes: int  # real payload length before padding
     forced_cut: bool = False  # True: chunk END was cut mid-token (token > chunk_bytes)
+    corpus: int = 0  # which named corpus this chunk's document belongs to
+    # (multi-corpus input API): index into Config.corpora(). Redundant
+    # with doc_id + the job's corpus bounds — the authoritative mapping
+    # apps consume via App.corpus_bounds — but tagged here so ingest-side
+    # consumers never re-derive the boundary arithmetic.
 
 
 def _ws_cut(data: bytes, start: int, end: int) -> tuple[int, bool]:
@@ -164,16 +169,25 @@ def chunk_document(
 
 
 def iter_chunks(
-    paths: Sequence[str | os.PathLike], chunk_bytes: int
+    paths: Sequence[str | os.PathLike], chunk_bytes: int,
+    corpus_bounds: Sequence[int] = (),
 ) -> Iterator[Chunk]:
     """Stream all input files as chunks, doc_id = position in ``paths``.
 
     Reads and normalizes incrementally — peak host memory is one window,
-    not the corpus (contrast src/mr/worker.rs:73-76).
+    not the corpus (contrast src/mr/worker.rs:73-76). With
+    ``corpus_bounds`` (resolve_corpora), each chunk is tagged with its
+    document's corpus id.
     """
+    import bisect
+
+    bounds = list(corpus_bounds or ())
     for doc_id, path in enumerate(paths):
+        corpus = bisect.bisect_right(bounds, doc_id) if bounds else 0
         with open(path, "rb") as f:
-            yield from chunk_stream(f, doc_id, chunk_bytes)
+            for c in chunk_stream(f, doc_id, chunk_bytes):
+                yield (dataclasses.replace(c, corpus=corpus)
+                       if corpus else c)
 
 
 def list_inputs(input_dir: str, pattern: str = "*.txt") -> list[str]:
@@ -181,3 +195,58 @@ def list_inputs(input_dir: str, pattern: str = "*.txt") -> list[str]:
     import glob
 
     return sorted(glob.glob(os.path.join(input_dir, pattern)))
+
+
+def parse_input_spec(values: Sequence[str]):
+    """The CLI's ``--input`` forms → (input_dir, input_dirs):
+
+    - ``--input DIR`` — classic single corpus: (DIR, None). ONE value is
+      always this form, '=' in the path included;
+    - ``--input a=DIR b=DIR`` — N (>= 2) named corpora, canonically
+      sorted by name (the submission-digest and join-side ordering
+      contract: ``a=X b=Y`` and ``b=Y a=X`` are the SAME job):
+      (first dir, sorted ((name, dir), ...)).
+
+    Mixing the two forms (or repeating a name) is a usage error.
+    """
+    vals = list(values)
+    if len(vals) == 1:
+        # ONE value is always the classic directory form — even when the
+        # path contains '=' (a legal dir name like data/run=5). A single
+        # NAMED corpus would be pointless anyway: names only distinguish
+        # sides once there are two.
+        return vals[0], None
+    pairs = []
+    for v in vals:
+        name, sep, d = v.partition("=")
+        if not sep or not name or not d:
+            raise ValueError(
+                f"multi-corpus --input wants name=DIR entries, got {v!r} "
+                "(single-corpus form takes exactly one bare DIR)"
+            )
+        pairs.append((name, d))
+    names = [n for n, _ in pairs]
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate corpus names in --input: {names}")
+    pairs.sort()
+    return pairs[0][1], tuple(pairs)
+
+
+def resolve_corpora(cfg) -> tuple[list[str], tuple, tuple]:
+    """Flatten the job's corpora (Config.corpora()) into the doc_id
+    space: (inputs, corpus_bounds, names). ``inputs`` concatenates each
+    corpus's sorted listing in corpus order; ``corpus_bounds`` holds the
+    cumulative doc counts of corpora[:-1] — the boundaries
+    splitter.prepare_app binds onto multi-corpus apps. Single-corpus
+    configs come back with bounds == () so every classic caller keeps
+    flat-list semantics."""
+    corpora = cfg.corpora()
+    inputs: list[str] = []
+    bounds: list[int] = []
+    for name, d in corpora:
+        inputs.extend(list_inputs(d, cfg.input_pattern))
+        bounds.append(len(inputs))
+    names = tuple(n for n, _ in corpora)
+    if len(corpora) == 1:
+        return inputs, (), names
+    return inputs, tuple(bounds[:-1]), names

@@ -67,7 +67,7 @@ from mapreduce_rust_tpu.ops.groupby import (
     merge_batches,
 )
 from mapreduce_rust_tpu.ops.tokenize import tokenize_and_hash
-from mapreduce_rust_tpu.runtime.chunker import chunk_stream, list_inputs
+from mapreduce_rust_tpu.runtime.chunker import chunk_stream, resolve_corpora
 from mapreduce_rust_tpu.runtime.dictionary import (
     Dictionary,
     ShardedDictionary,
@@ -2725,6 +2725,7 @@ def run_job(
     inputs: Sequence[str] | None = None,
     app: App | None = None,
     write_outputs: bool = True,
+    corpus_bounds: Sequence[int] | None = None,
 ) -> JobResult:
     """Run one job end-to-end. Exact results on any device/mesh shape.
 
@@ -2733,10 +2734,20 @@ def run_job(
     streaming merge-join egress and JobResult.table comes back EMPTY —
     the results live in the output files, whose content is identical to
     the in-RAM path's.
+
+    Multi-corpus jobs (ISSUE 15): with ``inputs=None`` the corpora come
+    from Config.corpora() (``input_dirs``) and the flat doc_id space
+    concatenates their sorted listings; explicit ``inputs`` callers pass
+    the matching ``corpus_bounds`` (resolve_corpora's) themselves.
     """
     t0 = time.perf_counter()
     app = app or WordCount()
-    inputs = list(inputs) if inputs is not None else list_inputs(cfg.input_dir, cfg.input_pattern)
+    if inputs is None:
+        inputs, auto_bounds, _names = resolve_corpora(cfg)
+        if corpus_bounds is None:
+            corpus_bounds = auto_bounds
+    else:
+        inputs = list(inputs)
     if not inputs:
         raise ValueError("no input files")
 
@@ -2757,6 +2768,14 @@ def run_job(
     from mapreduce_rust_tpu.analysis.sanitize import new_dictionary, new_job_stats
 
     stats = new_job_stats(cfg)
+    # Workload plane (ISSUE 15): bind corpus bounds and — for range apps —
+    # sampler-derived splitters onto the app BEFORE anything streams. The
+    # pre-pass is seeded and pure in (inputs, config), so every engine and
+    # every re-execution derives identical routing; its cost lands in
+    # stats.splitter_s/splitter_samples for the bench sort leg.
+    from mapreduce_rust_tpu.runtime.splitter import prepare_app
+
+    app = prepare_app(app, cfg, inputs, corpus_bounds or (), stats=stats)
     # Crash-safe run scavenging (ISSUE 11 satellite): a SIGKILLed job's
     # remove_runs never ran, so its dictrun-*/accrun-* files leak forever
     # in a shared work_dir. Reclaim orphans whose writer pid is gone (live
@@ -3080,12 +3099,18 @@ def _stream_finalize(cfg: Config, app: App, stats: JobStats, acc: HostAccumulato
                     words = spill_io.slice_block_words(
                         sources, src_b[hits], idx_b[hits]
                     )
-                    rr = (
-                        (keys_b[hits] >> np.uint64(32)).astype(np.int64)
-                        % cfg.reduce_n
-                    ).tolist()
+                    # Routing goes through the app's partition seam
+                    # (ISSUE 15): hash apps keep k1 % reduce_n, range
+                    # apps (sort) searchsorted the word prefixes over
+                    # their sampler-bound splitters — element-wise equal
+                    # to App.route, the in-RAM tier's router.
+                    rr = app.route_block(
+                        words,
+                        (keys_b[hits] >> np.uint64(32)).astype(np.int64),
+                        cfg.reduce_n,
+                    )
                     pos_h = pos[hits]
-                    fmt = app.format_line
+                    emit = app.emit_lines
                     # One buffered write per (block, partition), not one
                     # per line: the formatted lines batch through a join.
                     blk_lines: list[list] = [[] for _ in range(cfg.reduce_n)]
@@ -3093,14 +3118,14 @@ def _stream_finalize(cfg: Config, app: App, stats: JobStats, acc: HostAccumulato
                         for w, r, i, j2 in zip(
                             words, rr, pos_h.tolist(), ends_g[hits].tolist()
                         ):
-                            blk_lines[r].append(
-                                fmt(w, sorted(rows[i:j2, 2].tolist()))
+                            blk_lines[r].extend(
+                                emit(w, sorted(rows[i:j2, 2].tolist()))
                             )
                     else:
                         for w, r, v in zip(
                             words, rr, rows[pos_h, 2].tolist()
                         ):
-                            blk_lines[r].append(fmt(w, v))
+                            blk_lines[r].extend(emit(w, v))
                     for r, ls in enumerate(blk_lines):
                         if ls:
                             parts[r].write(b"\n".join(ls) + b"\n")

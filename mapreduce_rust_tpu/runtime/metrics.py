@@ -182,6 +182,17 @@ class JobStats:
     # bytes of formatted output per reduce partition (index = r): the
     # reduce-side skew signal the doctor scores — a hot partition here is
     # the key-distribution problem the reference can't even see
+    # ---- workload plane (ISSUE 15) ----
+    partition_mode: str = "hash"  # how this run's egress routed keys to
+    # partitions: "hash" (k1 % reduce_n) or "range" (searchsorted over
+    # sampled splitters — sort). The doctor reads it to pick which skew
+    # advice applies to partition_bytes (raise reduce_n vs raise
+    # split_samples).
+    splitter_samples: int = 0     # tokens the sampled-splitter pre-pass
+    # drew across all inputs (range apps only; 0 = no pre-pass ran)
+    splitter_s: float = 0.0       # wall seconds of the sample+derive
+    # pre-pass — the splitter-overhead the bench sort leg records; it
+    # must stay O(samples), invisible next to the stream
     mesh_shard_rows: list = dataclasses.field(default_factory=list)
     # final valid records per mesh shard (hash-class skew across chips)
     hists: dict = dataclasses.field(default_factory=dict)

@@ -1,0 +1,180 @@
+"""Sampled-splitter subsystem (ISSUE 15 tentpole): the cheap pre-pass
+that turns "range partition" from a config flag into derived, DETERMINISTIC
+splitters.
+
+The TeraSort problem: a globally sorted output needs partition r's keys to
+all precede partition r+1's, but nobody knows the key distribution before
+reading the corpus. The classic answer (Coded TeraSort, arXiv:1702.04850;
+every TeraSort since O'Malley's) is sampling: read a small, seeded sample
+of keys from each input, merge the samples on the driver/coordinator side,
+and take R−1 quantiles as range splitters. This module is that subsystem:
+
+- :func:`sample_file` — per-input sampling: a handful of evenly spaced
+  blocks (never the whole file — the pre-pass must stay O(samples), not
+  O(corpus)), normalized and tokenized with the CORPUS pipeline's own
+  rules (core/normalize + dictionary.extract_words, so the sample space
+  is exactly the key space), edge tokens dropped (a block boundary may
+  clip them), then a seeded ``random.Random`` draw.
+- :func:`derive_splitters` — merge + sort all samples, take the R−1
+  evenly spaced order statistics of the packed-uint64 prefixes
+  (ops/partition.pack_word_prefix). Pure order statistics, no
+  interpolation: splitters are always REAL sampled keys, exact uint64.
+- :func:`splitters_for_job` — the one entry point drivers AND workers
+  call. Everything downstream of (sorted input listing, seed,
+  split_samples) is a pure function, which is the determinism contract
+  the chaos ``kill`` leg tests: a re-executed map task re-derives
+  bit-identical splitters from the same seeded sample, so two attempts
+  of one task can never route one key to two partitions.
+
+Skew is expected and MEASURED, not hidden: too few samples on a skewed
+corpus gives uneven partitions, which shows up in the per-partition
+output bytes (``stats.partition_bytes``) the doctor already scores — the
+``splitter-quality`` finding names this module's knob
+(``Config.split_samples`` / ``--split-samples``) as the fix.
+
+No jax import (package rule: the pre-pass runs in backend-free worker
+processes and must cost milliseconds).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import time
+from typing import Sequence
+
+import numpy as np
+
+from mapreduce_rust_tpu.ops.partition import pack_word_prefix
+
+#: Fixed sampling seed: splitters must be a pure function of the corpus
+#: and config so re-executed tasks agree (Config carries no seed knob on
+#: purpose — a wall-clock or per-process seed here would break the
+#: bit-identical-outputs invariant on every recovery path).
+SPLIT_SEED = 0x517
+#: Block size and per-file block count of the sampling pre-pass.
+SAMPLE_BLOCK_BYTES = 64 << 10
+SAMPLE_MAX_BLOCKS = 8
+
+
+def sample_file(path: str | os.PathLike, samples: int,
+                seed: int = SPLIT_SEED,
+                file_index: int = 0) -> list[bytes]:
+    """Seeded token sample from one input file: up to SAMPLE_MAX_BLOCKS
+    evenly spaced SAMPLE_BLOCK_BYTES reads, tokenized with the corpus
+    pipeline's rules, first/last token of each interior block dropped
+    (possibly clipped by the block cut), then a ``random.Random((seed,
+    file_index))`` draw of ``samples`` tokens. Deterministic for a fixed
+    (path contents, samples, seed, file_index)."""
+    from mapreduce_rust_tpu.core.normalize import normalize_unicode
+    from mapreduce_rust_tpu.runtime.dictionary import extract_words
+
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return []
+    if size <= 0:
+        return []
+    n_blocks = min(SAMPLE_MAX_BLOCKS,
+                   max(1, size // SAMPLE_BLOCK_BYTES or 1))
+    pool: list[bytes] = []
+    with open(path, "rb") as f:
+        for b in range(n_blocks):
+            # Even spacing over the file so a sorted or clustered corpus
+            # still samples its whole key range, head and tail included.
+            off = (size - SAMPLE_BLOCK_BYTES) * b // max(n_blocks - 1, 1) \
+                if size > SAMPLE_BLOCK_BYTES else 0
+            f.seek(max(off, 0))
+            raw = f.read(SAMPLE_BLOCK_BYTES)
+            if not raw:
+                continue
+            toks = extract_words(normalize_unicode(raw))
+            if off > 0 and toks:
+                toks = toks[1:]  # head token may be a clipped fragment
+            if off + len(raw) < size and toks:
+                toks = toks[:-1]  # tail token likewise
+            pool.extend(toks)
+    if not pool:
+        return []
+    # One int seed per (job seed, file): stable across interpreter
+    # versions (tuple seeding is deprecated hash-based).
+    rng = random.Random((int(seed) << 20) ^ int(file_index))
+    if len(pool) <= samples:
+        return pool
+    return rng.sample(pool, samples)
+
+
+def corpus_samples(inputs: Sequence[str | os.PathLike],
+                   samples_per_file: int,
+                   seed: int = SPLIT_SEED) -> np.ndarray:
+    """Merged driver-side sample over the whole (sorted) input listing:
+    uint64[n] packed word prefixes. The file index — the doc_id ordering
+    contract (chunker.list_inputs) — keys each file's rng stream, so the
+    merged sample is independent of which process sampled which file."""
+    words: list[bytes] = []
+    for i, path in enumerate(inputs):
+        words.extend(sample_file(path, samples_per_file, seed=seed,
+                                 file_index=i))
+    return pack_word_prefix(words)
+
+
+def derive_splitters(samples: np.ndarray, reduce_n: int) -> np.ndarray:
+    """R−1 range splitters from merged packed-uint64 samples: the evenly
+    spaced order statistics of the sorted sample. Returns uint64
+    [reduce_n - 1]; an EMPTY sample yields all-max splitters (every key
+    below the max sentinel routes to partition 0 — exact, maximally
+    skewed, and the doctor's splitter-quality finding will say so)."""
+    r = max(int(reduce_n), 1)
+    if r == 1:
+        return np.zeros(0, dtype=np.uint64)
+    s = np.sort(np.asarray(samples, dtype=np.uint64))
+    if not len(s):
+        return np.full(r - 1, np.iinfo(np.uint64).max, dtype=np.uint64)
+    idx = (np.arange(1, r, dtype=np.int64) * len(s)) // r
+    return s[np.minimum(idx, len(s) - 1)]
+
+
+def splitters_for_job(cfg, inputs: Sequence[str | os.PathLike]) -> np.ndarray:
+    """THE shared sampler entry: seeded sample of every input, merged,
+    reduced to cfg.reduce_n − 1 splitters. Driver run_job and every
+    distributed worker call exactly this, so a re-executed task's
+    splitters are bit-identical to the first attempt's — the determinism
+    half of the range-partition contract (tested by the chaos kill leg,
+    tests/test_sort_join.py)."""
+    samples = corpus_samples(inputs, cfg.split_samples)
+    return derive_splitters(samples, cfg.reduce_n)
+
+
+def prepare_app(app, cfg, inputs: Sequence[str | os.PathLike],
+                corpus_bounds: Sequence[int] = (), stats=None):
+    """Bind the job-derived partitioning state onto the app (frozen
+    dataclass → a rebound COPY): corpus bounds for multi-corpus apps
+    (join's side split) and sampler-derived splitters for range apps
+    (sort). Validates the app's corpus-arity contract at bind time — a
+    join submitted with one corpus must fail HERE, before any lease or
+    chunk. ``stats`` (a JobStats) gets the splitter pre-pass accounting
+    when given."""
+    bounds = tuple(int(b) for b in (corpus_bounds or ()))
+    need = getattr(app, "requires_corpora", 0)
+    if need and len(bounds) != need - 1:
+        raise ValueError(
+            f"app {app.name!r} needs exactly {need} input corpora "
+            f"(got {len(bounds) + 1}); submit them as --input a=DIR b=DIR"
+        )
+    if getattr(app, "corpus_bounds", ()) != bounds:
+        app = dataclasses.replace(app, corpus_bounds=bounds)
+    if app.partition_mode == "range" \
+            and len(app.splitters) != max(cfg.reduce_n - 1, 0):
+        t0 = time.perf_counter()
+        samples = corpus_samples(inputs, cfg.split_samples)
+        spl = derive_splitters(samples, cfg.reduce_n)
+        app = dataclasses.replace(
+            app, splitters=tuple(int(x) for x in spl)
+        )
+        if stats is not None:
+            stats.splitter_samples = int(len(samples))
+            stats.splitter_s = time.perf_counter() - t0
+    if stats is not None:
+        stats.partition_mode = app.partition_mode
+    return app

@@ -101,11 +101,14 @@ log = logging.getLogger("mapreduce_rust_tpu.service")
 #: App names a spec may name. A static list, NOT the registry import: the
 #: registry pulls in the jax-importing app modules, and spec validation
 #: runs inside the backend-free service process.
-APP_NAMES = ("grep", "inverted_index", "top_k", "word_count")
+APP_NAMES = ("grep", "inverted_index", "join", "sort", "top_k", "word_count")
 
 #: Spec fields that change a job's OUTPUT — the config-digest input. A
 #: field outside this set (priority, labels) must never split the cache.
-_CONFIG_KEYS = ("app", "app_args", "reduce_n", "input_pattern")
+#: split_samples IS output-determining: different sample counts derive
+#: different splitters, which move range-partition boundaries.
+_CONFIG_KEYS = ("app", "app_args", "reduce_n", "input_pattern",
+                "split_samples")
 
 
 def scan_corpus(input_dir: str, pattern: str) -> tuple:
@@ -121,6 +124,12 @@ def scan_corpus(input_dir: str, pattern: str) -> tuple:
 
     sig = hashlib.sha256()
     total = 0
+    if not input_dir or not os.path.isdir(input_dir):
+        # A missing/empty dir must not glob relative to the service's
+        # CWD (os.path.join("", "*.txt") == "*.txt") — the submit
+        # handler runs on the event loop and a malformed spec must cost
+        # O(1), not a directory scan of wherever the service started.
+        return [], 0, sig.hexdigest()[:16]
     paths = sorted(glob.glob(os.path.join(input_dir, pattern)))
     for p in paths:
         try:
@@ -134,6 +143,42 @@ def scan_corpus(input_dir: str, pattern: str) -> tuple:
     return paths, total, sig.hexdigest()[:16]
 
 
+def spec_corpora(spec: dict) -> list:
+    """The spec's ordered (name, dir) corpus list — multi-corpus specs
+    carry ``inputs`` ([[name, dir], ...]); classic specs are one unnamed
+    corpus at ``input_dir``. Shared by validation, digesting and the
+    per-job config, so 'which corpora' has exactly one reading."""
+    corp = spec.get("inputs")
+    if corp:
+        return [(str(n), str(d)) for n, d in corp]
+    return [("corpus", spec.get("input_dir") or "")]
+
+
+def scan_corpus_spec(spec: dict) -> tuple:
+    """scan_corpus over EVERY corpus of a spec: (flat sorted paths, total
+    bytes, combined digest). Single-corpus specs reuse scan_corpus's
+    digest unchanged (cache entries from before the multi-corpus API stay
+    valid); N corpora combine per-corpus digests UNDER THEIR NAMES, so
+    the same directories grouped differently — a=X b=Y vs a=Y b=X — are
+    different jobs (they are: join's sides swap)."""
+    pattern = spec.get("input_pattern") or "*.txt"
+    corpora = spec_corpora(spec)
+    if len(corpora) == 1:
+        return scan_corpus(corpora[0][1], pattern)
+    sig = hashlib.sha256()
+    total = 0
+    all_paths: list = []
+    # Canonical NAME order, whatever order the submitter listed — this is
+    # where a=X b=Y and b=Y a=X become one digest (validate_spec sorts
+    # the spec the same way, so pre- and post-validation scans agree).
+    for name, d in sorted(corpora):
+        paths, nbytes, dg = scan_corpus(d, pattern)
+        sig.update(f"{name}={dg};".encode())
+        total += nbytes
+        all_paths.extend(paths)
+    return all_paths, total, sig.hexdigest()[:16]
+
+
 def validate_spec(spec, inputs: "list | None" = None) -> dict:
     """Normalize + validate one job spec (the ``submit_job`` payload).
     Returns the canonical spec dict; raises ValueError on a bad one —
@@ -145,17 +190,58 @@ def validate_spec(spec, inputs: "list | None" = None) -> dict:
     app = spec.get("app")
     if app not in APP_NAMES:
         raise ValueError(f"unknown app {app!r}; have {sorted(APP_NAMES)}")
-    input_dir = spec.get("input_dir")
-    if not input_dir or not os.path.isdir(input_dir):
-        raise ValueError(f"input_dir {input_dir!r} is not a directory")
     pattern = spec.get("input_pattern") or "*.txt"
+    # Multi-corpus input API (ISSUE 15): ``inputs`` = [[name, dir], ...],
+    # canonically SORTED BY NAME (a=X b=Y and b=Y a=X are the same job —
+    # the digest-stability contract) — or the classic single input_dir.
+    corpora = spec.get("inputs")
+    if corpora is not None:
+        if (not isinstance(corpora, (list, tuple)) or not corpora
+                or not all(
+                    isinstance(p, (list, tuple)) and len(p) == 2
+                    and all(isinstance(x, str) and x for x in p)
+                    for p in corpora
+                )):
+            raise ValueError(
+                "inputs must be a non-empty list of [name, dir] pairs"
+            )
+        names = [n for n, _ in corpora]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate corpus names {names}")
+        corpora = sorted(
+            (n, os.path.abspath(d)) for n, d in corpora
+        )
+        for name, d in corpora:
+            if not os.path.isdir(d):
+                raise ValueError(f"corpus {name!r}: {d!r} is not a directory")
+        input_dir = corpora[0][1]
+    else:
+        input_dir = spec.get("input_dir")
+        if not input_dir or not os.path.isdir(input_dir):
+            raise ValueError(f"input_dir {input_dir!r} is not a directory")
+    if app == "join" and len(corpora or []) != 2:
+        raise ValueError(
+            "join needs exactly two named corpora "
+            '(inputs: [["a", DIR], ["b", DIR]])'
+        )
     if inputs is None:
-        inputs = scan_corpus(input_dir, pattern)[0]
+        probe = dict(spec)
+        if corpora is not None:
+            probe["inputs"] = corpora
+        inputs = scan_corpus_spec(probe)[0]
     if not inputs:
         raise ValueError(f"no inputs matching {pattern!r} in {input_dir!r}")
     reduce_n = spec.get("reduce_n", 4)
     if not isinstance(reduce_n, int) or reduce_n < 1:
         raise ValueError("reduce_n must be a positive integer")
+    # Canonicalized to an EXPLICIT value: splitter derivation must be a
+    # pure function of the spec alone — a fleet member falling back to
+    # its own CLI default here could derive different splitters than its
+    # peers for the same sort job, routing one key to two partitions.
+    split_samples = spec.get("split_samples", 512)
+    if not isinstance(split_samples, int) or isinstance(split_samples, bool) \
+            or split_samples < 1:
+        raise ValueError("split_samples must be a positive integer")
     app_args = spec.get("app_args") or {}
     if not isinstance(app_args, dict):
         raise ValueError("app_args must be an object")
@@ -183,13 +269,17 @@ def validate_spec(spec, inputs: "list | None" = None) -> dict:
                 "grep needs app_args.query: a non-empty list of words"
             )
         app_args = {**app_args, "query": list(q)}
-    return {
+    out = {
         "app": app,
         "app_args": app_args,
         "input_dir": os.path.abspath(input_dir),
         "input_pattern": pattern,
         "reduce_n": reduce_n,
+        "split_samples": split_samples,
     }
+    if corpora is not None:
+        out["inputs"] = [[n, d] for n, d in corpora]
+    return out
 
 
 def corpus_digest(input_dir: str, pattern: str) -> str:
@@ -216,15 +306,20 @@ class _ResultCache:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        # In-flight dedup tier (ISSUE 15 satellite): identical submissions
+        # that JOINED a still-running twin instead of recomputing. Counted
+        # beside the done-hits so the service leg's hit rate can split
+        # hit_done vs hit_inflight.
+        self.hits_inflight = 0
 
     @staticmethod
     def key(spec: dict, digest: "str | None" = None) -> str:
         """THE cache-key constructor — every writer and prober builds the
         key here (a second hand-rolled join would silently de-sync put
         and get). ``digest`` is an already-scanned corpus digest
-        (scan_corpus); None rescans."""
+        (scan_corpus_spec); None rescans (every corpus of the spec)."""
         if digest is None:
-            digest = corpus_digest(spec["input_dir"], spec["input_pattern"])
+            digest = scan_corpus_spec(spec)[2]
         return ":".join((spec["app"], digest, config_digest(spec)))
 
     def get(self, key: str) -> "dict | None":
@@ -254,7 +349,11 @@ class _ResultCache:
             self.evictions += 1
 
     def stats(self) -> dict:
-        return {"hits": self.hits, "misses": self.misses,
+        # "hits" stays the done-hit counter pre-dedup consumers read;
+        # hit_done aliases it, hit_inflight is the join-the-twin tier.
+        return {"hits": self.hits, "hit_done": self.hits,
+                "hit_inflight": self.hits_inflight,
+                "misses": self.misses,
                 "evictions": self.evictions, "entries": len(self._d)}
 
 
@@ -269,9 +368,15 @@ class Job:
     spec: dict
     priority: int
     seq: int
-    state: str = "queued"        # queued|running|done|cancelled|failed
+    state: str = "queued"        # queued|joined|running|done|cancelled|failed
     cached: bool = False
     cache_key: str = ""
+    joined: "str | None" = None  # in-flight dedup (ISSUE 15 satellite):
+    # the still-queued/running twin this identical submission joined
+    # instead of recomputing. A joined job holds NO scheduler state and
+    # grants NOTHING; it completes (state done, cached=True, the twin's
+    # outputs) when the twin does, and re-queues for real computation if
+    # the twin fails or is cancelled.
     bytes_in: int = 0
     submitted_s: float = 0.0     # service-uptime stamps
     started_s: "float | None" = None
@@ -301,6 +406,9 @@ class Job:
             "queue_wait_s": round(self.queue_wait_s(now), 3),
             "bytes_in": self.bytes_in,
         }
+        if self.joined is not None:
+            # The ISSUE 15 dedup contract: job_status names the twin.
+            out["joined"] = self.joined
         if self.started_s is not None:
             end = self.done_s if self.done_s is not None else now
             out["run_s"] = round(max(end - self.started_s, 0.0), 3)
@@ -476,9 +584,11 @@ class JobService:
                  cache_key: "str | None" = None) -> Job:
         if nbytes is None or cache_key is None:
             # Replay/direct callers arrive without a scan; submit_job
-            # threads its single pass through.
-            _paths, nbytes, digest = scan_corpus(spec["input_dir"],
-                                                 spec["input_pattern"])
+            # threads its single pass through. scan_corpus_spec, not
+            # scan_corpus: a replayed multi-corpus job digested over its
+            # first corpus only would mint a key its own completion row
+            # can never hit.
+            _paths, nbytes, digest = scan_corpus_spec(spec)
             cache_key = _ResultCache.key(spec, digest)
         job = Job(jid=jid, spec=spec, priority=priority,
                   seq=next(self._seq), bytes_in=nbytes,
@@ -500,12 +610,12 @@ class JobService:
         try:
             if not isinstance(spec, dict):
                 raise ValueError("job spec must be an object")
-            input_dir = spec.get("input_dir") or ""
-            pattern = spec.get("input_pattern") or "*.txt"
-            paths, nbytes, digest = (
-                scan_corpus(input_dir, pattern)
-                if os.path.isdir(input_dir) else ([], 0, "")
-            )
+            # ONE listing pass over every corpus of the spec (the
+            # blocking-in-async doctrine): scan_corpus_spec iterates
+            # canonical name order and digests by (basename, size,
+            # mtime), so the pre-validation scan equals the canonical
+            # spec's — validate_spec then reuses the listing.
+            paths, nbytes, digest = scan_corpus_spec(spec)
             spec = validate_spec(spec, inputs=paths)
             priority = int(priority or 0)
         except (ValueError, TypeError) as e:
@@ -532,6 +642,38 @@ class JobService:
             log.info("job %s: cache hit (source %s) — served without "
                      "computing", jid, hit.get("job"))
             return {"ok": True, "job": jid, "state": "done", "cached": True}
+        twin = self._inflight_twin(key)
+        if twin is not None:
+            # In-flight dedup (ISSUE 15 satellite — the ROADMAP item-2
+            # follow-on's small half): an identical submission whose twin
+            # is still queued/running JOINS it instead of recomputing —
+            # zero new grants, no coordinator, no admission bytes. The
+            # twin's completion completes this job with the same outputs
+            # (_propagate_joined); its failure re-queues this one for
+            # real computation.
+            now = self.report.uptime_s()
+            job = Job(jid=jid, spec=spec, priority=priority,
+                      seq=next(self._seq), state="joined", cache_key=key,
+                      joined=twin.jid, bytes_in=nbytes, submitted_s=now)
+            self.jobs[jid] = job
+            if twin.state == "queued" and priority > twin.priority:
+                # Priority inheritance: a high-priority duplicate must
+                # not inherit its low-priority twin's queue position
+                # (pre-dedup it would have ADMITTED ahead). Raise the
+                # twin and push a fresh heap entry — the stale lower-
+                # priority entry pops harmlessly later (its job is no
+                # longer queued by then, or the fresh entry admitted it
+                # first).
+                twin.priority = priority
+                heapq.heappush(self._queue,
+                               (-priority, twin.seq, twin.jid))
+            self.cache.hits_inflight += 1
+            self._journal("submit", jid, spec=spec, priority=priority,
+                          joined=twin.jid)
+            log.info("job %s: joined in-flight twin %s (%s) — zero new "
+                     "grants", jid, twin.jid, twin.state)
+            return {"ok": True, "job": jid, "state": "joined",
+                    "cached": False, "joined": twin.jid}
         job = self._enqueue(jid, spec, priority, nbytes=nbytes,
                             cache_key=key)
         self._journal("submit", jid, spec=spec, priority=priority)
@@ -539,6 +681,45 @@ class JobService:
                  spec["app"], job.bytes_in / (1 << 20), priority)
         self._admit_tick()
         return {"ok": True, "job": jid, "state": job.state, "cached": False}
+
+    def _inflight_twin(self, key: str) -> "Job | None":
+        """A queued/running job with the same result-cache key — the
+        dedup probe. Joined jobs themselves never match (no chains: every
+        duplicate attaches to the ONE computing twin)."""
+        if not key:
+            return None
+        for j in self.jobs.values():
+            if j.cache_key == key and j.state in ("queued", "running"):
+                return j
+        return None
+
+    def _propagate_joined(self, src: Job) -> None:
+        """Settle every job that joined ``src`` now that src is terminal:
+        done → the joined jobs complete with src's outputs (an inflight
+        cache hit, journaled like one); failed/cancelled → they re-queue
+        as real computations (the submitter still wants a result — the
+        dedup must never amplify one twin's failure)."""
+        for j in list(self.jobs.values()):
+            if j.state != "joined" or j.joined != src.jid:
+                continue
+            now = self.report.uptime_s()
+            if src.state == "done":
+                j.state = "done"
+                j.cached = True
+                j.outputs = list(src.outputs)
+                j.done_s = now
+                self._note_done(j.jid)
+                self._journal("done", j.jid, state="done", cached=True,
+                              cache_key=j.cache_key, outputs=j.outputs,
+                              source_job=src.jid)
+                log.info("job %s: completed by joined twin %s",
+                         j.jid, src.jid)
+            else:
+                j.joined = None
+                j.state = "queued"
+                heapq.heappush(self._queue, (-j.priority, j.seq, j.jid))
+                log.info("job %s: twin %s %s — re-queued for real "
+                         "computation", j.jid, src.jid, src.state)
 
     def job_status(self, jid=None) -> dict:
         """Per-job view. For a RUNNING job this is the coordinator
@@ -578,12 +759,15 @@ class JobService:
         job = self.jobs.get(jid) if isinstance(jid, str) else None
         if job is None:
             return {"ok": False, "error": f"unknown job {jid!r}"}
-        if job.state == "queued":
+        if job.state in ("queued", "joined"):
             job.state = "cancelled"
             job.done_s = self.report.uptime_s()
             self._note_done(jid)
             self._journal("cancel", jid)
-            # The heap entry stays; _admit_tick skips cancelled jobs.
+            # The heap entry (if any) stays; _admit_tick skips cancelled
+            # jobs. A cancelled QUEUED twin must settle its joiners too.
+            self._propagate_joined(job)
+            self._admit_tick()
             return {"ok": True, "job": jid, "state": "cancelled"}
         if job.state == "running":
             # Stop granting from this job; outstanding leases answer
@@ -637,11 +821,12 @@ class JobService:
         # .get, not [..]: a cancelled-while-queued job's heap entry
         # outlives its record once DONE_JOBS_MAX retention evicts it — a
         # stale entry must read as "not queued", never KeyError a stats
-        # RPC on a long-lived service.
-        return sum(
-            1 for (_p, _s, jid) in self._queue
+        # RPC on a long-lived service. Distinct jids: priority
+        # inheritance (in-flight dedup) can leave a job two heap entries.
+        return len({
+            jid for (_p, _s, jid) in self._queue
             if (j := self.jobs.get(jid)) is not None and j.state == "queued"
-        )
+        })
 
     def inflight_bytes(self) -> int:
         return sum(j.bytes_in for j in self.running.values())
@@ -692,6 +877,7 @@ class JobService:
             self._note_done(job.jid)
             self._journal("done", job.jid, state="failed", error=str(e))
             log.warning("job %s: admission failed: %s", job.jid, e)
+            self._propagate_joined(job)
             return
         # The service owns worker registration; the per-job barrier is
         # open by construction (worker_n=1, count synced to the fleet).
@@ -707,22 +893,28 @@ class JobService:
                  job.bytes_in / (1 << 20))
 
     def _job_cfg(self, job: Job) -> Config:
-        from mapreduce_rust_tpu.runtime.chunker import list_inputs
+        from mapreduce_rust_tpu.runtime.chunker import resolve_corpora
 
         spec = job.spec
-        inputs = list_inputs(spec["input_dir"], spec["input_pattern"])
+        corp = spec.get("inputs")
+        probe = dataclasses.replace(
+            self.cfg,
+            input_dir=spec["input_dir"],
+            input_dirs=(tuple((n, d) for n, d in corp) if corp else None),
+            input_pattern=spec["input_pattern"],
+        )
+        inputs, _bounds, _names = resolve_corpora(probe)
         if not inputs:
             raise ValueError(
                 f"no inputs matching {spec['input_pattern']!r} in "
                 f"{spec['input_dir']!r} (corpus removed since submit?)"
             )
         return dataclasses.replace(
-            self.cfg,
+            probe,
             map_n=len(inputs),
             reduce_n=spec["reduce_n"],
+            split_samples=int(spec.get("split_samples") or 512),
             worker_n=1,
-            input_dir=spec["input_dir"],
-            input_pattern=spec["input_pattern"],
             work_dir=os.path.join(self.cfg.work_dir, f"job-{job.jid}"),
             output_dir=os.path.join(self.cfg.output_dir, f"job-{job.jid}"),
             # Per-job coordinators are embedded state machines: the
@@ -792,7 +984,7 @@ class JobService:
         job = self.jobs.get(jid) if isinstance(jid, str) else None
         if job is None or job.cfg is None or job.state != "running":
             return {"ok": False, "error": f"unknown or not-running job {jid!r}"}
-        return {
+        out = {
             "ok": True,
             "job": job.jid,
             "app": job.spec["app"],
@@ -801,9 +993,18 @@ class JobService:
             "input_pattern": job.cfg.input_pattern,
             "map_n": job.cfg.map_n,
             "reduce_n": job.cfg.reduce_n,
+            # The splitter-derivation input rides the spec so EVERY
+            # fleet member samples identically, whatever its own CLI
+            # defaults (range apps' cross-worker determinism contract).
+            "split_samples": job.cfg.split_samples,
             "work_dir": job.cfg.work_dir,
             "output_dir": job.cfg.output_dir,
         }
+        if job.spec.get("inputs"):
+            # Multi-corpus job: the worker re-resolves the same ordered
+            # corpora (ISSUE 15 — join's sides, sort's sample listing).
+            out["inputs"] = [[n, d] for n, d in job.spec["inputs"]]
+        return out
 
     def _job_for(self, jid) -> "Job | None":
         job = self.jobs.get(jid) if isinstance(jid, str) else None
@@ -952,6 +1153,10 @@ class JobService:
         trace_instant("service.job_done", job=job.jid, state=state)
         log.info("job %s: %s (%s)", job.jid, state,
                  job.coord.report.summary() if job.coord else "no report")
+        # Settle in-flight-dedup joiners now the twin is terminal: done →
+        # they complete with these outputs; failed/cancelled → re-queue
+        # (the _admit_tick below picks them up).
+        self._propagate_joined(job)
         # Late RPCs for a closed job answer stale/moot (_job_for filters
         # on running), so the scheduler state can die with the job.
         job.coord = None
@@ -1045,6 +1250,9 @@ class JobService:
         g.gauge("service.workers").set(sv["workers"])
         cache = sv["cache"]
         g.counter("service.cache_hits").set_total(cache["hits"])
+        g.counter("service.cache_hits_inflight").set_total(
+            cache["hit_inflight"]
+        )
         g.counter("service.cache_misses").set_total(cache["misses"])
         g.counter("service.cache_evictions").set_total(cache["evictions"])
         g.histogram("service.queue_wait_s").set_hist(self._queue_wait_hist)

@@ -55,7 +55,7 @@ from mapreduce_rust_tpu.coordinator.server import (
 )
 from mapreduce_rust_tpu.core.hashing import hash_words
 from mapreduce_rust_tpu.runtime.backoff import Backoff, BackoffExhausted
-from mapreduce_rust_tpu.runtime.chunker import chunk_stream, list_inputs
+from mapreduce_rust_tpu.runtime.chunker import chunk_stream
 from mapreduce_rust_tpu.runtime.dictionary import Dictionary, extract_words
 from mapreduce_rust_tpu.runtime.metrics import (
     start_metrics,
@@ -100,7 +100,17 @@ class Worker:
         self.cfg = cfg
         self.app = app or get_app("word_count")
         self.engine = engine
-        self.inputs = list_inputs(cfg.input_dir, cfg.input_pattern)
+        # Multi-corpus input API (ISSUE 15): the flat doc_id space
+        # concatenates every corpus's sorted listing; prepare_app binds
+        # the boundaries (join's side split) and — for range apps —
+        # derives splitters from the SHARED seeded sampler, so every
+        # worker process and every re-executed attempt routes keys
+        # identically (the chaos kill leg's determinism contract).
+        from mapreduce_rust_tpu.runtime.chunker import resolve_corpora
+        from mapreduce_rust_tpu.runtime.splitter import prepare_app
+
+        self.inputs, bounds, _names = resolve_corpora(cfg)
+        self.app = prepare_app(self.app, cfg, self.inputs, bounds)
         self.work = pathlib.Path(cfg.work_dir)
         self.out = pathlib.Path(cfg.output_dir)
         self.worker_id: int | None = None
@@ -390,13 +400,46 @@ class Worker:
         self.work.mkdir(parents=True, exist_ok=True)
         op = self.app.combine_op
         reduce_n = self.cfg.reduce_n
+        # Partition routing goes through the app seam (ISSUE 15): hash
+        # apps keep k1 % reduce_n; range apps (sort) need the WORD to
+        # searchsorted their sampler-bound splitters — resolved in one
+        # vectorized route_block sweep over the task dictionary's sorted
+        # stream (iter_sorted serves spilled dictionaries too), keeping
+        # only a hash→partition INT per key, never a second copy of the
+        # word bytes. A key the dictionary somehow lost routes to
+        # partition 0 — the same key would be an unknown_keys count at
+        # egress.
+        route = self.app.route
+        part_of: "dict | None" = None
+        if self.app.partition_mode == "range":
+            part_of = {}
+            blk_keys: list = []
+            blk_words: list = []
+
+            def _route_blk() -> None:
+                if blk_words:
+                    rr = self.app.route_block(
+                        blk_words, [k1 for k1, _ in blk_keys], reduce_n
+                    )
+                    part_of.update(zip(blk_keys, rr))
+                    blk_keys.clear()
+                    blk_words.clear()
+
+            for _packed, k1, k2, word in dictionary.iter_sorted():
+                blk_keys.append((k1, k2))
+                blk_words.append(word)
+                if len(blk_words) >= (1 << 16):
+                    _route_blk()
+            _route_blk()
         parts: dict[int, list] = {r: [] for r in range(reduce_n)}
         for (k1, k2), v in table.items():
+            r = part_of.get((k1, k2), 0) if part_of is not None \
+                else route(None, k1, reduce_n)
             if op == "distinct":
                 for d in sorted(v):
-                    parts[k1 % reduce_n].append((k1, k2, d))
+                    parts[r].append((k1, k2, d))
             else:
-                parts[k1 % reduce_n].append((k1, k2, v))
+                parts[r].append((k1, k2, v))
         for r, rows in parts.items():
             arr = np.asarray(rows, dtype=np.int64).reshape(-1, 3)
             _atomic_savez(
@@ -405,15 +448,17 @@ class Worker:
                 k2=arr[:, 1].astype(np.uint32),
                 value=arr[:, 2].astype(np.int64),
             )
-        # Dictionary shards are partitioned by the same k1 % reduce_n route
-        # as the spills, so reduce task r reads exactly its own words —
+        # Dictionary shards are partitioned by the same app route as the
+        # spills, so reduce task r reads exactly its own words —
         # mirroring the mr-{m}-{r} protocol (src/mr/worker.rs:121).
         # iter_sorted, not items(): it serves the WHOLE dictionary whether
         # or not a budget flush spilled words to disk runs (items() raises
         # on a spilled instance — mrlint rule spilled-dict-api caught this).
         dict_parts: dict[int, Dictionary] = {r: Dictionary() for r in range(reduce_n)}
         for _packed, k1, k2, word in dictionary.iter_sorted():
-            dict_parts[k1 % reduce_n]._word_of[(k1, k2)] = word
+            r = part_of.get((k1, k2), 0) if part_of is not None \
+                else route(word, k1, reduce_n)
+            dict_parts[r]._word_of[(k1, k2)] = word
         for r, dp in dict_parts.items():
             dp.collisions = list(dictionary.collisions) if r == 0 else []
             _atomic_write(self.work / f"dict-{tid}-{r}.txt", dp.save)
@@ -995,7 +1040,8 @@ class ServiceWorker(Worker):
         import dataclasses
 
         from mapreduce_rust_tpu.apps import get_app
-        from mapreduce_rust_tpu.runtime.chunker import list_inputs
+        from mapreduce_rust_tpu.runtime.chunker import resolve_corpora
+        from mapreduce_rust_tpu.runtime.splitter import prepare_app
 
         kwargs = dict(spec.get("app_args") or {})
         if spec["app"] == "grep":
@@ -1003,16 +1049,31 @@ class ServiceWorker(Worker):
         if spec["app"] == "top_k" and "k" in kwargs:
             kwargs["k"] = int(kwargs["k"])
         self.app = get_app(spec["app"], **kwargs)
+        # Multi-corpus jobs ship their ordered (name, dir) list in the
+        # spec (ISSUE 15); classic jobs keep the single input_dir form.
+        corpora = spec.get("inputs")
         self.cfg = dataclasses.replace(
             self._base_cfg,
             map_n=max(int(spec["map_n"]), 1),
             reduce_n=int(spec["reduce_n"]),
+            # From the SPEC, never this worker's CLI default: two fleet
+            # members sampling different counts would derive different
+            # splitters for one sort job and route one key two ways.
+            split_samples=int(spec.get("split_samples") or 512),
             input_dir=spec["input_dir"],
+            input_dirs=(
+                tuple((str(n), str(d)) for n, d in corpora)
+                if corpora else None
+            ),
             input_pattern=spec["input_pattern"],
             work_dir=spec["work_dir"],
             output_dir=spec["output_dir"],
         )
-        self.inputs = list_inputs(spec["input_dir"], spec["input_pattern"])
+        self.inputs, bounds, _names = resolve_corpora(self.cfg)
+        # Range apps re-derive splitters HERE, from the same seeded
+        # sampler as every other fleet member — no splitter exchange RPC,
+        # no divergence: the sample is a pure function of the listing.
+        self.app = prepare_app(self.app, self.cfg, self.inputs, bounds)
         self.work = pathlib.Path(spec["work_dir"])
         self.out = pathlib.Path(spec["output_dir"])
         self._job_ctx = spec["job"]

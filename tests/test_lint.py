@@ -1360,3 +1360,88 @@ def test_device_dispatch_silent_outside_hot_scopes(tmp_path):
             return jax.device_put(chunk, device)
     """)
     assert fired == []
+
+
+# ---------------------------------------------------------------------------
+# Rule 15 unsampled-range-partition (ISSUE 15): range-partition calls
+# consume SAMPLER-derived splitters, never ad-hoc literals.
+# ---------------------------------------------------------------------------
+
+def test_range_partition_fires_on_literal_splitters(tmp_path):
+    fired, report = program_rules_fired(tmp_path, """
+        from mapreduce_rust_tpu.ops.partition import range_partition
+
+        def route(keys):
+            return range_partition(keys, [10, 20, 30])
+    """)
+    assert fired == ["unsampled-range-partition"]
+    assert "sampler" in report.findings[0].message
+
+
+def test_range_partition_fires_through_literal_alias(tmp_path):
+    # The reaching-defs half: a name assigned from a literal container
+    # (np.array over a list counts) cannot hide the provenance.
+    fired, _ = program_rules_fired(tmp_path, """
+        import numpy as np
+        from mapreduce_rust_tpu.ops.partition import range_partition
+
+        def route(keys):
+            spl = np.array([10, 20, 30], dtype=np.uint64)
+            return range_partition(keys, splitters=spl)
+    """)
+    assert fired == ["unsampled-range-partition"]
+
+
+def test_range_partition_silent_on_sampler_derivation(tmp_path):
+    fired, _ = program_rules_fired(tmp_path, """
+        from mapreduce_rust_tpu.ops.partition import range_partition
+        from mapreduce_rust_tpu.runtime.splitter import derive_splitters
+
+        def route(keys, samples, reduce_n):
+            spl = derive_splitters(samples, reduce_n)
+            return range_partition(keys, spl)
+    """)
+    assert fired == []
+
+
+def test_range_partition_silent_on_bound_app_splitters(tmp_path):
+    # The bound-app seam: .splitters is written only by prepare_app, so
+    # reading it (possibly through an asarray wrap) is sampler-derived.
+    fired, _ = program_rules_fired(tmp_path, """
+        import numpy as np
+        from mapreduce_rust_tpu.ops.partition import range_partition
+
+        def route_block(app, packed, reduce_n):
+            return range_partition(
+                packed, np.asarray(app.splitters, dtype=np.uint64)
+            )
+    """)
+    assert fired == []
+
+
+def test_range_bucket_scatter_audited_hash_mode_ignored(tmp_path):
+    # The device twin: bucket_scatter(mode="range") is a range-partition
+    # call site too; hash mode carries no splitters and stays silent.
+    fired, _ = program_rules_fired(tmp_path, """
+        from mapreduce_rust_tpu.ops.partition import bucket_scatter
+
+        def shuffle_bad(batch, d, cap):
+            return bucket_scatter(batch, d, cap, mode="range",
+                                  splitters=[[0, 1], [2, 3]])
+
+        def shuffle_ok(batch, d, cap):
+            return bucket_scatter(batch, d, cap, mode="hash")
+    """)
+    assert fired == ["unsampled-range-partition"]
+
+
+def test_range_partition_silent_on_unresolvable_value(tmp_path):
+    # Precision over recall: a parameter (or foreign call) the dataflow
+    # layer cannot resolve stays silent rather than crying wolf.
+    fired, _ = program_rules_fired(tmp_path, """
+        from mapreduce_rust_tpu.ops.partition import range_partition
+
+        def route(keys, spl):
+            return range_partition(keys, spl)
+    """)
+    assert fired == []

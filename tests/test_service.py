@@ -526,6 +526,147 @@ def test_cache_hit_completes_with_zero_new_grants(tmp_path):
     asyncio.run(go())
 
 
+def test_inflight_dedup_joins_running_twin_zero_new_grants(tmp_path):
+    """ISSUE 15 acceptance: an identical submission made while its twin
+    is RUNNING grants zero new map tasks and returns the twin's result —
+    job_status reports the joined twin, and the cache counters split
+    hit_done vs hit_inflight."""
+    docs = write_corpus(tmp_path / "in", TEXTS_A)
+    spec = {"app": "word_count", "input_dir": docs, "reduce_n": 3}
+    n_inputs = len(list(pathlib.Path(docs).glob("*.txt")))
+
+    async def go():
+        cfg = make_cfg(tmp_path)
+        svc = JobService(cfg)
+        serve = asyncio.create_task(svc.serve())
+        await asyncio.sleep(0.2)
+        client = CoordinatorClient(cfg.host, cfg.port, timeout_s=15.0)
+        await client.connect()
+        # Submit the twin FIRST (it admits and RUNS — no workers yet, so
+        # it cannot finish), then the identical repeat: deterministic
+        # in-flight window.
+        r1 = await client.call("submit_job", spec)
+        assert r1["state"] == "running"
+        r2 = await client.call("submit_job", spec)
+        assert r2["state"] == "joined" and r2["joined"] == r1["job"]
+        st2 = await client.call("job_status", r2["job"])
+        assert st2["state"] == "joined" and st2["joined"] == r1["job"]
+        # No result yet: the join must not fabricate one.
+        res2 = await client.call("get_result", r2["job"])
+        assert res2["ok"] is False and res2["state"] == "joined"
+        ws = [ServiceWorker(cfg) for _ in range(2)]
+        workers = [asyncio.create_task(w.run()) for w in ws]
+        for _ in range(300):
+            st2 = await client.call("job_status", r2["job"])
+            if st2.get("state") == "done":
+                break
+            await asyncio.sleep(0.1)
+        assert st2["state"] == "done"
+        st1 = await client.call("job_status", r1["job"])
+        assert st1["state"] == "done"
+        # ZERO new map tasks for the joined job: the twin computed every
+        # input exactly once, and the joined job has no report at all.
+        assert st1["totals"]["map"]["completed"] == n_inputs
+        assert sum(
+            t["grants"] for t in st1["tasks"]["map"].values()
+        ) == n_inputs
+        assert st2.get("totals") is None
+        assert st2["cached"] is True and st2["joined"] == r1["job"]
+        # The twin's result, byte for byte the same files.
+        assert st2["outputs"] == st1["outputs"]
+        res2 = await client.call("get_result", r2["job"])
+        assert res2["ok"] and res2["outputs"] == st1["outputs"]
+        # Counter split: one inflight hit, zero done hits.
+        view = await client.call("list_jobs")
+        cache = view["service"]["cache"]
+        assert cache["hit_inflight"] == 1 and cache["hit_done"] == 0
+        await client.call("shutdown")
+        await client.close()
+        await asyncio.wait_for(asyncio.gather(*workers), timeout=30)
+        await asyncio.wait_for(serve, timeout=30)
+
+    asyncio.run(go())
+
+
+def test_inflight_dedup_requeues_when_twin_cancelled(tmp_path):
+    # The failure half of the dedup contract, in-process: cancelling the
+    # computing twin re-queues the joined submission as a REAL job — the
+    # dedup must never amplify one cancellation into two lost results.
+    docs = write_corpus(tmp_path / "in", TEXTS_A)
+    svc = JobService(make_cfg(tmp_path))
+    spec = {"app": "word_count", "input_dir": docs}
+    r1 = svc.submit_job(dict(spec))
+    r2 = svc.submit_job(dict(spec))
+    assert r2["state"] == "joined"
+    svc.cancel_job(r1["job"])
+    j2 = svc.jobs[r2["job"]]
+    assert j2.state == "running" and j2.joined is None  # re-admitted
+    # And a joined job is itself cancellable while waiting.
+    r3 = svc.submit_job(dict(spec))
+    assert r3["joined"] == r2["job"]
+    assert svc.cancel_job(r3["job"])["ok"]
+    assert svc.jobs[r3["job"]].state == "cancelled"
+
+
+def test_inflight_dedup_inherits_priority(tmp_path):
+    # A high-priority duplicate must not inherit its queued twin's LOW
+    # queue position: the twin's priority raises to the max of the two
+    # (pre-dedup, the duplicate would have admitted ahead).
+    docs = write_corpus(tmp_path / "in", TEXTS_A)
+    svc = JobService(make_cfg(tmp_path, service_max_jobs=1))
+    head = svc.submit_job({"app": "word_count", "input_dir": docs})
+    low = svc.submit_job({"app": "word_count", "input_dir": docs,
+                          "reduce_n": 2}, 0)
+    mid = svc.submit_job({"app": "word_count", "input_dir": docs,
+                          "reduce_n": 5}, 3)
+    dup = svc.submit_job({"app": "word_count", "input_dir": docs,
+                          "reduce_n": 2}, 9)
+    assert dup["joined"] == low["job"]
+    assert svc.jobs[low["job"]].priority == 9
+    # Duplicate heap entries from the raise never double-count.
+    assert svc.queued_count() == 2
+    svc.cancel_job(head["job"])
+    assert svc.jobs[low["job"]].state == "running"   # admitted FIRST
+    assert svc.jobs[mid["job"]].state == "queued"
+
+
+def test_multi_corpus_join_job_through_service(tmp_path):
+    """Multi-corpus input API end to end (ISSUE 15): a join spec with two
+    named corpora rides submit_job → job_spec → ServiceWorker, and the
+    outputs match the same join run through the single-process driver."""
+    da = write_corpus(tmp_path / "in-a", TEXTS_A)
+    db = write_corpus(tmp_path / "in-b", TEXTS_B)
+    spec = {"app": "join", "reduce_n": 3,
+            "inputs": [["a", da], ["b", db]]}
+
+    cfg = make_cfg(tmp_path)
+    svc, results = asyncio.run(_drive_service(cfg, [spec], n_workers=2))
+    jid = results[0]["job"]
+    got = output_bytes(pathlib.Path(cfg.output_dir) / f"job-{jid}")
+    assert got, "service join produced no outputs"
+
+    # Driver-side reference run over the same corpora.
+    from mapreduce_rust_tpu.apps import get_app
+    from mapreduce_rust_tpu.runtime.driver import run_job
+
+    ref_cfg = Config(
+        map_engine="host", reduce_n=3, device="cpu", chunk_bytes=4096,
+        input_dirs=(("a", da), ("b", db)),
+        output_dir=str(tmp_path / "ref-out"),
+        work_dir=str(tmp_path / "ref-work"),
+    )
+    ref = run_job(ref_cfg, app=get_app("join"))
+    ref_bytes = {
+        pathlib.Path(p).name: pathlib.Path(p).read_bytes()
+        for p in ref.output_files
+    }
+    assert got == ref_bytes
+    # mrcheck over the service root: the multi-corpus job's protocol
+    # artifacts replay clean like every other job's.
+    doc = run_check(str(cfg.work_dir))
+    assert doc["ok"], doc["violations"]
+
+
 def test_service_worker_trims_packed_fns_between_jobs(tmp_path):
     """ISSUE 14 satellite: the jit packed-merge cache teardown (PR 11's
     trim hook) runs at JOB boundaries in a service worker, not only at
