@@ -2028,6 +2028,22 @@ def service_leg(k_jobs: int | None = None) -> None:
     except Exception as e:  # an uncheckable leg is a failed leg
         ok = False
         result["mrcheck"] = {"ok": False, "error": repr(e)}
+    # Fleet profiler (ISSUE 16) over the same work root: cross-job
+    # utilization, barrier-bubble fraction and the per-job pipelining
+    # opportunity — the three series doctor trend watches for the
+    # scheduling plane. Post-mortem only (journal + reports), so a
+    # profiler failure degrades to nulls rather than failing the leg.
+    fleet_row: dict = {}
+    try:
+        from mapreduce_rust_tpu.runtime.fleet import (
+            build_fleet_report, fleet_history_row,
+        )
+
+        frep = build_fleet_report(str(root / "work"))
+        fleet_row = fleet_history_row(frep)
+        result.update(fleet_row)
+    except Exception as e:
+        result["fleet_error"] = repr(e)
     result["ok"] = ok
     _append_history({
         "metric": result["metric"],
@@ -2039,6 +2055,7 @@ def service_leg(k_jobs: int | None = None) -> None:
         "service_cache_hit_rate": result.get("cache_hit_rate"),
         "service_k_jobs": k_jobs,
         "service_mrcheck": result.get("mrcheck"),
+        **fleet_row,
         "error": result.get("error"),
     })
     print(json.dumps(result))
@@ -2306,7 +2323,8 @@ def _append_history(result: dict) -> None:
         # series — bad direction: down).
         line.update({
             k: v for k, v in result.items()
-            if k.startswith(("chaos_", "service_", "sort_"))
+            if k.startswith(("chaos_", "service_", "sort_", "fleet_",
+                             "pipelining_"))
         })
         if result.get("chaos_scenario"):
             line["doctor_findings"] = [

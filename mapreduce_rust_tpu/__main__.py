@@ -12,6 +12,7 @@ src/clean.sh), as subcommands of one module:
     python -m mapreduce_rust_tpu clean       # rm intermediates/outputs
     python -m mapreduce_rust_tpu doctor      # automated run diagnosis
     python -m mapreduce_rust_tpu check       # protocol conformance + races
+    python -m mapreduce_rust_tpu fleet       # cross-job utilization/bubbles
 
 Unlike the reference — where the worker learns map_n/reduce_n from its own
 argv and a mismatch silently mis-shards the shuffle (SURVEY.md §3-E) — both
@@ -718,6 +719,15 @@ def cmd_check(args) -> int:
     return run_cli(args)
 
 
+def cmd_fleet(args) -> int:
+    """Fleet profiler (ISSUE 16): cross-job utilization timeline,
+    barrier-bubble accounting, pipelining opportunity. Backend-free like
+    check/lint/doctor — joins on-disk artifacts, never dials a server."""
+    from mapreduce_rust_tpu.runtime.fleet import run_cli
+
+    return run_cli(args)
+
+
 def cmd_lint(args) -> int:
     """mrlint: the framework-invariant static analyzer (analysis/). Pure
     ast + stdlib — no jax import, so it runs in any process in
@@ -978,6 +988,23 @@ def main(argv: list[str] | None = None) -> int:
                    help="json: the full conformance document for CI diffs")
     p.add_argument("-v", "--verbose", action="store_true")
 
+    p = sub.add_parser(
+        "fleet",
+        help="fleet profiler: cross-job per-worker busy/idle timeline, "
+        "barrier-bubble accounting and pipelining opportunity from a "
+        "service root (service.journal + job-*/) or a single workdir",
+    )
+    p.add_argument("target",
+                   help="service work root (service.journal + job-* dirs) "
+                   "or a single-job work dir (job_report.json)")
+    p.add_argument("--format", choices=["text", "json"], default="text",
+                   help="json: the full fleet report for CI diffs")
+    p.add_argument("--baseline", default=None, metavar="PATH",
+                   help="prior fleet report (JSON): exit 1 when "
+                   "fleet_bubble_frac regressed beyond the guard band")
+    p.add_argument("-v", "--verbose", action="store_true",
+                   help="text format: print every timeline interval")
+
     p = sub.add_parser("stats", help="pretty-print a run manifest, or diff two")
     p.add_argument("manifest", help="manifest.json of a run")
     p.add_argument("other", nargs="?", default=None,
@@ -1119,6 +1146,7 @@ def main(argv: list[str] | None = None) -> int:
         "watch": cmd_watch,
         "lint": cmd_lint,
         "check": cmd_check,
+        "fleet": cmd_fleet,
     }[args.cmd](args)
 
 

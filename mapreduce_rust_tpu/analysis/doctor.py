@@ -705,6 +705,62 @@ def service_findings(service: "dict | None") -> list:
                 "cap"
             ),
         })
+    # ---- fleet profiler plane (ISSUE 16) ----
+    fl = service.get("fleet_util")
+    if isinstance(fl, dict) and (fl.get("active_ws") or 0) >= 5.0:
+        # ≥ 5 fleet worker-seconds observed: below that a single poll gap
+        # reads as a 100% bubble. Thresholds are deliberately coarse —
+        # these are operator prompts, not SLO breaches.
+        bubble = fl.get("bubble_frac") or 0.0
+        if bubble > 0.25:
+            findings.append({
+                "severity": "warn", "code": "barrier-bubble",
+                "key": "barrier-bubble",
+                "message": (
+                    f"{bubble:.0%} of fleet worker-seconds idle while "
+                    "reduce work was barrier-blocked or jobs sat queued "
+                    f"({fl.get('bubble_ws', 0):.1f} worker-s) — the "
+                    "pipelining headroom ROADMAP item 1 targets; see "
+                    "`fleet <work-root>` for the per-job breakdown"
+                ),
+            })
+        utils = [
+            w.get("util_frac") for w in (fl.get("workers") or {}).values()
+            if isinstance(w, dict) and not w.get("drained")
+            and isinstance(w.get("util_frac"), (int, float))
+        ]
+        if len(utils) >= 2 and max(utils) > 0.2:
+            mean = sum(utils) / len(utils)
+            if mean > 0 and max(utils) / mean > 2.0:
+                findings.append({
+                    "severity": "warn", "code": "fleet-imbalance",
+                    "key": "fleet-imbalance",
+                    "message": (
+                        f"worker utilization is imbalanced: max "
+                        f"{max(utils):.0%} vs fleet mean {mean:.0%} — "
+                        "admission-order granting is starving part of "
+                        "the fleet (long map tasks on one worker, or a "
+                        "worker polling a barrier-gated job)"
+                    ),
+                })
+    slo = service.get("slo")
+    if isinstance(slo, dict):
+        lo = _hist((slo.get("low") or {}).get("queue_wait_s"))
+        hi = _hist((slo.get("high") or {}).get("queue_wait_s"))
+        if lo is not None and hi is not None:
+            lo95 = lo.percentile(0.95) or 0.0
+            hi95 = hi.percentile(0.95) or 0.0
+            if lo95 > 1.0 and lo95 > 4.0 * max(hi95, 0.05):
+                findings.append({
+                    "severity": "warn", "code": "admission-starvation",
+                    "key": "admission-starvation",
+                    "message": (
+                        f"low-priority queue-wait p95 {lo95:.2f}s vs "
+                        f"high-priority {hi95:.2f}s — strict-priority "
+                        "admission is starving the low class; consider "
+                        "aging or a budget carve-out"
+                    ),
+                })
     return findings
 
 
@@ -869,6 +925,16 @@ TREND_SERIES: dict[str, str] = {
     # each invisible to the hash legs.
     "sort_wall_s": "up",
     "sort_skew": "up",
+    # Fleet profiler (ISSUE 16): the bench service leg's cross-job
+    # accounting. Bubble fraction drifting UP means more fleet
+    # worker-seconds lost to the map barrier / admission queue; util
+    # drifting DOWN is the same loss seen from the other side; the
+    # pipelining opportunity drifting UP means the barrier is leaving
+    # ever more reclaimable headroom on the table (ROADMAP item 1's
+    # before/after number).
+    "fleet_bubble_frac": "up",
+    "fleet_util_frac": "down",
+    "pipelining_opportunity_s": "up",
 }
 
 

@@ -149,6 +149,13 @@ INVARIANTS: dict[str, tuple[str, str]] = {
         "under job B — job state is strictly per-job (ISSUE 14: the "
         "multi-tenant service's cross-job misroute class)",
     ),
+    "job-lifecycle": (
+        "service-journal",
+        "every job's service-journal rows follow the lifecycle machine: "
+        "submit before start/done/cancel, at most one terminal row, no "
+        "rows after a terminal (double start = restart re-admission and "
+        "done-without-start = cache hit are legal)",
+    ),
 }
 
 
@@ -777,6 +784,101 @@ def check_trace(events: list, journal: "list | None" = None) -> list[Violation]:
 # Driver + CLI
 # ---------------------------------------------------------------------------
 
+def load_service_journal(path: str) -> list:
+    """Rows of a JobService admission journal (JSONL). Torn tail and
+    non-row lines are skipped — the service's own replay distrusts them
+    the same way."""
+    with open(path) as f:
+        text = f.read()
+    lines = text.splitlines()
+    if text and not text.endswith("\n") and lines:
+        lines.pop()  # torn tail
+    rows = []
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(row, dict) and row.get("job") and row.get("op"):
+            rows.append(row)
+    return rows
+
+
+def check_service_journal(rows: list) -> "list[Violation]":
+    """Job-lifecycle state machine over a service admission journal
+    (ISSUE 16): submit -> start -> done|cancel per job, in file order.
+
+    Legal shapes the machine must NOT flag: a second ``start`` (service
+    restart re-admits a requeued job), ``done`` without ``start`` (cache
+    hit / joined twin settles a job straight from the queue), ``cancel``
+    from the queue. Violations: any row for a job never submitted, a
+    second terminal row, and any row after a terminal one.
+    """
+    violations: list[Violation] = []
+    state: dict = {}  # jid -> "queued" | "running" | terminal op
+
+    def _ev(row: dict) -> dict:
+        return {"ev": "service-journal", "op": row.get("op"),
+                "job": row.get("job"), "t": row.get("t")}
+
+    first: dict = {}  # jid -> first row (for violation context)
+    for row in rows:
+        jid, op = row["job"], row["op"]
+        st = state.get(jid)
+        if st in ("done", "cancel"):
+            violations.append(Violation(
+                "job-lifecycle",
+                f"job {jid}: '{op}' row after terminal '{st}' — a settled "
+                "job's lifecycle is closed (no grants, no re-settling)",
+                [first[jid], _ev(row)],
+            ))
+            continue
+        if op == "submit":
+            if st is not None:
+                violations.append(Violation(
+                    "job-lifecycle",
+                    f"job {jid}: duplicate submit — job ids are single-"
+                    "use",
+                    [first[jid], _ev(row)],
+                ))
+                continue
+            state[jid] = "queued"
+            first[jid] = _ev(row)
+        elif op in ("start", "done", "cancel"):
+            if st is None:
+                violations.append(Violation(
+                    "job-lifecycle",
+                    f"job {jid}: '{op}' without a prior submit — the "
+                    "admission journal is the single source of job "
+                    "existence",
+                    [_ev(row)],
+                ))
+                continue
+            state[jid] = "running" if op == "start" else op
+            # first[] already set by submit
+    return violations
+
+
+def _service_journal_pass(target: str, checked: dict,
+                          violations: list) -> None:
+    """Run the job-lifecycle machine over ``<target>/service.journal``
+    when present (service root, or a single-job dir checked alongside
+    the service journal that admitted it). Appends Violation dicts and
+    records the row count under ``checked['service_journal_lines']`` —
+    a separate counter, so per-job ``journal_lines`` stays comparable
+    across single-job and service runs."""
+    spath = os.path.join(target, "service.journal")
+    if not os.path.isfile(spath):
+        return
+    rows = load_service_journal(spath)
+    checked["service_journal_lines"] = len(rows)
+    checked["sources"]["service_journal"] = spath
+    violations.extend(x.to_dict() for x in check_service_journal(rows))
+
+
 def _service_job_dirs(target: str) -> list:
     """job-* subdirs of a JobService work root that hold checkable
     artifacts (per-job journal or job report)."""
@@ -850,6 +952,7 @@ def run_check_service(target: str, job_dirs: list,
             violations.append(row)
         checked["trace_events"] = len(trace_events)
         checked["sources"]["trace"] = trace
+    _service_journal_pass(target, checked, violations)
     return {
         "tool": "mrcheck",
         "schema": CHECK_SCHEMA,
@@ -913,21 +1016,28 @@ def run_check(target: str, trace: "str | None" = None,
         except ValueError as e:
             raise ValueError(f"{trace}: {e}") from None
         art["sources"]["trace"] = trace
+    vdicts = [x.to_dict() for x in violations]
+    checked = {
+        "events": len(events),
+        "events_dropped": dropped,
+        "authoritative": art["authoritative"],
+        "journal_lines": len(art["journal"] or []),
+        "trace_events": len(trace_events) if trace_events is not None
+        else None,
+        "sources": art["sources"],
+    }
+    if os.path.isdir(target):
+        # A single-job work dir can carry the admission journal that
+        # admitted it (mutation fixtures, copied service legs) — the
+        # lifecycle machine runs wherever the artifact lands.
+        _service_journal_pass(target, checked, vdicts)
     return {
         "tool": "mrcheck",
         "schema": CHECK_SCHEMA,
-        "ok": not violations,
-        "violations": [x.to_dict() for x in violations],
+        "ok": not vdicts,
+        "violations": vdicts,
         "invariants": sorted(INVARIANTS),
-        "checked": {
-            "events": len(events),
-            "events_dropped": dropped,
-            "authoritative": art["authoritative"],
-            "journal_lines": len(art["journal"] or []),
-            "trace_events": len(trace_events) if trace_events is not None
-            else None,
-            "sources": art["sources"],
-        },
+        "checked": checked,
     }
 
 
@@ -1181,6 +1291,25 @@ def mutate_finish_without_journal(workdir: str) -> str:
     return "finish-without-journal"
 
 
+def mutate_job_lifecycle(workdir: str) -> str:
+    """Synthesize a corrupt service admission journal beside the run's
+    artifacts: a 'start' row for a job never submitted, then a row after
+    the job settles. The single-job fixture has no service.journal of its
+    own — the lifecycle machine runs wherever the artifact lands, so a
+    planted one exercises it end to end."""
+    rows = [
+        {"op": "start", "job": "ghost", "t": 0.5},       # never submitted
+        {"op": "submit", "job": "j1", "t": 1.0},
+        {"op": "start", "job": "j1", "t": 1.1},
+        {"op": "done", "job": "j1", "t": 2.0, "state": "done"},
+        {"op": "start", "job": "j1", "t": 2.5},          # after terminal
+    ]
+    with open(os.path.join(workdir, "service.journal"), "w") as f:
+        for row in rows:
+            f.write(json.dumps(row) + "\n")
+    return "job-lifecycle"
+
+
 #: name -> (needs_trace, mutator). The seeded-violation fixture table:
 #: every entry corrupts a RECORDED run's artifacts so the named invariant
 #: fires with the offending event pair — proving the checker detects it —
@@ -1201,4 +1330,5 @@ MUTATIONS: dict = {
     "missing-terminator": (True, mutate_drop_terminator),
     "write-race": (True, mutate_write_race),
     "grant-across-jobs": (False, mutate_grant_across_jobs),
+    "job-lifecycle": (False, mutate_job_lifecycle),
 }

@@ -1607,6 +1607,90 @@ class UnsampledRangePartitionRule(ProgramRule):
                 )
 
 
+class UnreapedJobLabelsRule(ProgramRule):
+    """Per-job labeled metric series must have a reachable reap
+    (rule 16).
+
+    The multi-tenant service publishes ``job=<id>``-labeled gauges
+    (phase progress, tenant attribution) — one labeled child per live
+    job. Labels are an unbounded cardinality dimension: without a
+    matching ``remove_labels(job=...)`` on the job's teardown path,
+    every job that ever ran stays a live series forever, the Prometheus
+    scrape body grows without bound, and the registry lock is held
+    longer on every tick (the slow leak ISSUE 16's fleet plane would
+    itself be built on). The contract: any CLASS whose methods write a
+    mutator (``set``/``inc``/``observe``/``set_total``/``set_hist``)
+    with a ``job=`` kwarg must also, somewhere in its method set or
+    their sync call closure, call ``remove_labels``. Module-level
+    functions stay silent — a free function has no teardown seam to
+    anchor the reap to, and the repo's labeled writers are all
+    class-owned ticks.
+    """
+
+    name = "unreaped-job-labels"
+    summary = "job=-labeled metric writes need a reachable remove_labels reap"
+
+    _MUTATORS = ("set", "inc", "observe", "set_total", "set_hist")
+
+    def _job_label_sites(self, fu):
+        """Mutator calls carrying a ``job=`` kwarg, by direct AST walk —
+        qualname() cannot render call-containing receiver chains like
+        ``self.registry.gauge(...).set(...)``, so the verb + kwarg shape
+        is the detector."""
+        for n in ast.walk(fu.node):
+            if (
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr in self._MUTATORS
+                and any(kw.arg == "job" for kw in n.keywords)
+            ):
+                yield n
+
+    @staticmethod
+    def _has_reap(fu) -> bool:
+        return any(
+            isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+            and n.func.attr == "remove_labels"
+            for n in ast.walk(fu.node)
+        )
+
+    def run_program(self, program):
+        by_class: dict[tuple, list] = {}
+        for fu in program.functions:
+            if "." not in fu.qualname:
+                continue  # free function: no teardown seam to demand
+            cls = fu.qualname.rsplit(".", 1)[0]
+            by_class.setdefault((fu.path, cls), []).append(fu)
+        for (path, cls), methods in sorted(by_class.items()):
+            sites = [
+                (fu, call) for fu in methods
+                for call in self._job_label_sites(fu)
+            ]
+            if not sites:
+                continue
+            sanctioned = any(self._has_reap(fu) for fu in methods)
+            if not sanctioned:
+                for fu in methods:
+                    if any(
+                        self._has_reap(reached)
+                        for reached, _chain in program.reachable(fu)
+                    ):
+                        sanctioned = True
+                        break
+            if sanctioned:
+                continue
+            fu, call = sites[0]
+            yield self.finding(
+                path, call,
+                f"{cls} registers job=-labeled series "
+                f"({len(sites)} write site(s)) but no method reaches "
+                "remove_labels — every job that ever ran stays a live "
+                "labeled child and the scrape body grows without bound; "
+                "reap with registry.<instrument>.remove_labels(job=...) "
+                "on the job's teardown path",
+            )
+
+
 ALL_RULES: list[Rule] = [
     StatsOwnershipRule(),
     ExecutorTeardownRule(),
@@ -1632,4 +1716,5 @@ PROGRAM_RULES: list[ProgramRule] = [
     BlockingIoInFoldRule(),
     DeviceDispatchInConsumerRule(),
     UnsampledRangePartitionRule(),
+    UnreapedJobLabelsRule(),
 ]

@@ -676,7 +676,17 @@ class Coordinator:
         return done
 
     def report_map_task_finish(self, tid: int, attempt: int = 0,
-                               wid: int = -1) -> bool:
+                               wid: int = -1, job=None,
+                               part_bytes=None) -> bool:
+        # ``job``/``part_bytes`` are trailing default RPC fields (the
+        # wid/sample wire-compat pattern): old clients omit both. job is
+        # accepted-and-ignored here so the 5-positional service-worker
+        # report stays valid against a classic coordinator; part_bytes is
+        # the map task's per-reduce-partition intermediate-bytes vector —
+        # recorded on the FIRST report only (a late duplicate re-wrote
+        # identical shard files; readiness was already achieved).
+        if part_bytes is not None and tid not in self.map.reported:
+            self.report.record_partition_ready(tid, part_bytes)
         done = self._finish(self.map, "map", tid, attempt, wid)
         log.info("map %d finished (phase done=%s)", tid, done)
         return done

@@ -94,6 +94,12 @@ class JobReport:
         # vs the lease-expiry-only recovery — the doctor's
         # speculation-effectiveness input.
         self._speculation: dict[str, dict] = {}
+        # Per-reduce-partition readiness (ISSUE 16): r → {bytes, shards,
+        # ready_s}. Fed by map finish reports that ship their per-partition
+        # intermediate-bytes vector; ``ready_s`` is the report-epoch
+        # instant the LAST byte-contributing map shard for r landed — the
+        # fleet profiler's pipelining-opportunity input.
+        self._partitions: dict[int, dict] = {}
         self._t0 = time.monotonic()
 
     def _jdim(self) -> "str | None":
@@ -316,6 +322,42 @@ class JobReport:
         if w is not None:
             w["reports"] += 1
 
+    #: Remote-input backstop: a part_bytes vector longer than this is a
+    #: malformed (or hostile) report, not a real reduce_n — dropped.
+    PARTITIONS_CAP = 4096
+
+    def record_partition_ready(self, tid: int, part_bytes) -> None:
+        """Fold one map task's per-reduce-partition intermediate-bytes
+        vector (the trailing-default finish-report field) into the
+        readiness table. Only shards that carry bytes advance ``ready_s``
+        — an all-empty shard for r never gates r's pipeline start. The
+        caller (report_map_task_finish) invokes this on FIRST reports
+        only; duplicates re-wrote identical shard files."""
+        if not isinstance(part_bytes, (list, tuple)) \
+                or len(part_bytes) > self.PARTITIONS_CAP:
+            return
+        now = round(time.monotonic() - self._t0, 6)
+        for r, b in enumerate(part_bytes):
+            if isinstance(b, bool) or not isinstance(b, (int, float)):
+                return  # malformed vector: drop whole report, half a
+                # vector folded in would under-count some partitions
+        for r, b in enumerate(part_bytes):
+            slot = self._partitions.get(r)
+            if slot is None:
+                slot = self._partitions[r] = {
+                    "bytes": 0, "shards": 0, "ready_s": None,
+                }
+            slot["shards"] += 1
+            if b > 0:
+                slot["bytes"] += int(b)
+                slot["ready_s"] = now
+
+    def partitions_summary(self) -> dict:
+        return {
+            str(r): dict(slot)
+            for r, slot in sorted(self._partitions.items())
+        }
+
     def in_flight(self) -> list[tuple]:
         """(phase, tid) — or (job, phase, tid) for a multi-job writer's
         job-split slots — of tasks granted but not yet reported finished:
@@ -421,6 +463,8 @@ class JobReport:
             out["events_dropped"] = self._events_dropped
         if self._workers:
             out["workers"] = self.workers_summary()
+        if self._partitions:
+            out["partitions"] = self.partitions_summary()
         return out
 
     def summary(self) -> str:
@@ -556,6 +600,28 @@ def format_jobs(view: dict) -> str:
             f"{(f'{run:.1f}s' if run is not None else '-'):>7}  {task_s}"
             + (f"  [{j['error']}]" if j.get("error") else "")
         )
+    # Live fleet series (ISSUE 16): per-worker utilization + current job
+    # from the service's fleet_view(). Absent on pre-fleet services —
+    # the table renders without the block.
+    fl = sv.get("fleet_util") or {}
+    workers = fl.get("workers") or {}
+    if workers:
+        lines.append(
+            f"  fleet: util {fl.get('util_frac', 0.0):.0%} · "
+            f"bubble {fl.get('bubble_frac', 0.0):.0%}"
+        )
+        lines.append(f"  {'WID':>5} {'UTIL':>5} {'GRANTS':>6}  CURRENT")
+        for wid in sorted(workers, key=lambda w: int(w)):
+            row = workers[wid]
+            cur = "-"
+            if row.get("drained"):
+                cur = "(drained)"
+            elif row.get("job") is not None:
+                cur = f"{row['job']}:{row.get('phase', '?')}"
+            lines.append(
+                f"  {wid:>5} {row.get('util_frac', 0.0):>5.0%} "
+                f"{row.get('grants', 0):>6}  {cur}"
+            )
     return "\n".join(lines)
 
 

@@ -378,6 +378,8 @@ class Job:
     # outputs) when the twin does, and re-queues for real computation if
     # the twin fails or is cancelled.
     bytes_in: int = 0
+    grants: int = 0              # tenant attribution: task grants served
+    task_seconds: float = 0.0    # Σ attempt durations (final snapshot)
     submitted_s: float = 0.0     # service-uptime stamps
     started_s: "float | None" = None
     done_s: "float | None" = None
@@ -454,6 +456,22 @@ class JobService:
         self._live_findings: dict[str, dict] = {}
         self._queue_wait_hist = Histogram()
         self._job_wall_hist = Histogram()
+        # Per-priority-class SLO histograms (ISSUE 16): class →
+        # {queue_wait_s, exec_s, e2e_s}. Class = high/normal/low from the
+        # submission priority sign — the admission-starvation doctor
+        # finding compares low vs high queue-wait tails.
+        self._slo: dict[str, dict] = {}
+        # Live fleet-utilization state (ISSUE 16): wid → {job, phase,
+        # since, busy_s, grants}. Busy intervals open at task grant and
+        # close at the finish report; the integrator below folds
+        # idle/bubble worker-seconds on every observation (serve ticks,
+        # summaries), so `watch` can show per-worker utilization and the
+        # doctor can price the barrier bubble while jobs still run.
+        self._worker_state: dict[int, dict] = {}
+        self._fleet_last_s = 0.0
+        self._fleet_idle_ws = 0.0     # idle worker-seconds
+        self._fleet_bubble_ws = 0.0   # idle ∩ (queued job | map barrier)
+        self._fleet_active_ws = 0.0   # registered-and-not-drained w-s
         self.jobs_completed = 0
         self.cache = _ResultCache(cfg.service_cache_entries)
         self._pending_io: list = []  # executor futures (job-report
@@ -635,6 +653,7 @@ class JobService:
                       submitted_s=now, done_s=now)
             self.jobs[jid] = job
             self._note_done(jid)
+            self._slo_hists(priority)["e2e_s"].add(0.0)
             self._journal("submit", jid, spec=spec, priority=priority)
             self._journal("done", jid, state="done", cached=True,
                           cache_key=key, outputs=job.outputs,
@@ -709,6 +728,9 @@ class JobService:
                 j.outputs = list(src.outputs)
                 j.done_s = now
                 self._note_done(j.jid)
+                self._slo_hists(j.priority)["e2e_s"].add(
+                    max(now - j.submitted_s, 0.0)
+                )
                 self._journal("done", j.jid, state="done", cached=True,
                               cache_key=j.cache_key, outputs=j.outputs,
                               source_job=src.jid)
@@ -815,6 +837,133 @@ class JobService:
                      "stay journaled for restart)",
                      len(self.running), self.queued_count())
 
+    # ---- SLO classes + live fleet utilization (ISSUE 16) ----
+
+    @staticmethod
+    def _slo_class(priority: int) -> str:
+        return "high" if priority > 0 else ("low" if priority < 0 else
+                                            "normal")
+
+    def _slo_hists(self, priority: int) -> dict:
+        cls = self._slo_class(priority)
+        h = self._slo.get(cls)
+        if h is None:
+            h = self._slo[cls] = {
+                "queue_wait_s": Histogram(),
+                "exec_s": Histogram(),
+                "e2e_s": Histogram(),
+            }
+        return h
+
+    def _fleet_accumulate(self) -> None:
+        """Integrate idle/bubble worker-seconds since the last
+        observation. Bubble = idle while either a job sat queued or a
+        running job was blocked at the map barrier with at least one map
+        task already reported (reduce work EXISTED but could not start)
+        — the live counterpart of the fleet CLI's offline accounting."""
+        now = self.report.uptime_s()
+        dt = now - self._fleet_last_s
+        if dt <= 0:
+            return
+        self._fleet_last_s = now
+        active = [wid for wid in range(self.worker_count)
+                  if wid not in self.drained]
+        if not active:
+            return
+        self._fleet_active_ws += len(active) * dt
+        idle = sum(
+            1 for wid in active
+            if self._worker_state.get(wid) is None
+            or self._worker_state[wid]["job"] is None
+        )
+        if not idle:
+            return
+        self._fleet_idle_ws += idle * dt
+        bubble = self.queued_count() > 0
+        if not bubble:
+            for job in self.running.values():
+                c = job.coord
+                if c is not None and not c.map.finished and c.map.reported:
+                    bubble = True
+                    break
+        if bubble:
+            self._fleet_bubble_ws += idle * dt
+
+    def _fleet_grant(self, wid, jid: str, phase: str) -> None:
+        if not isinstance(wid, int) or wid < 0:
+            return
+        self._fleet_accumulate()  # close out the idle stretch FIRST
+        ws = self._worker_state.get(wid)
+        if ws is None:
+            ws = self._worker_state[wid] = {
+                "job": None, "phase": None, "since": 0.0,
+                "busy_s": 0.0, "grants": 0,
+            }
+        if ws["job"] is None:
+            ws["since"] = self.report.uptime_s()
+        ws["job"], ws["phase"] = jid, phase
+        ws["grants"] += 1
+
+    def _fleet_release(self, wid) -> None:
+        if not isinstance(wid, int) or wid < 0:
+            return
+        ws = self._worker_state.get(wid)
+        if ws is None or ws["job"] is None:
+            return
+        self._fleet_accumulate()
+        ws["busy_s"] += max(self.report.uptime_s() - ws["since"], 0.0)
+        ws["job"] = ws["phase"] = None
+
+    def fleet_view(self) -> dict:
+        """The live fleet-utilization block of service_summary: per-worker
+        busy seconds / utilization / current job, plus the integrated
+        fleet idle and bubble worker-seconds — what `watch` renders as
+        per-worker columns and `doctor trend` follows as
+        fleet_bubble_frac."""
+        self._fleet_accumulate()
+        now = self.report.uptime_s()
+        workers: dict = {}
+        busy_total = 0.0
+        for wid in range(self.worker_count):
+            ws = self._worker_state.get(wid)
+            busy = ws["busy_s"] if ws else 0.0
+            row: dict = {"grants": ws["grants"] if ws else 0}
+            if ws and ws["job"] is not None:
+                busy += max(now - ws["since"], 0.0)
+                row["job"] = ws["job"]
+                row["phase"] = ws["phase"]
+            row["busy_s"] = round(busy, 3)
+            row["util_frac"] = round(busy / now, 4) if now > 0 else 0.0
+            if wid in self.drained:
+                row["drained"] = True
+            busy_total += busy
+            workers[str(wid)] = row
+        denom = self._fleet_active_ws
+        return {
+            "workers": workers,
+            "busy_ws": round(busy_total, 3),
+            "active_ws": round(denom, 3),
+            "idle_ws": round(self._fleet_idle_ws, 3),
+            "bubble_ws": round(self._fleet_bubble_ws, 3),
+            "util_frac": round(busy_total / denom, 4) if denom > 0 else 0.0,
+            "bubble_frac": round(self._fleet_bubble_ws / denom, 4)
+            if denom > 0 else 0.0,
+        }
+
+    def _tenant_row(self, job: Job) -> dict:
+        ts = job.task_seconds
+        if job.coord is not None:
+            ts = sum(
+                h.total for h in job.coord.report._phase_hist.values()
+            )
+        return {
+            "state": job.state,
+            "priority": job.priority,
+            "grants": job.grants,
+            "bytes_in": job.bytes_in,
+            "task_seconds": round(ts, 3),
+        }
+
     # ---- admission control ----
 
     def queued_count(self) -> int:
@@ -885,6 +1034,9 @@ class JobService:
         job.state = "running"
         job.started_s = self.report.uptime_s()
         self._queue_wait_hist.add(job.queue_wait_s(job.started_s))
+        self._slo_hists(job.priority)["queue_wait_s"].add(
+            job.queue_wait_s(job.started_s)
+        )
         self.running[job.jid] = job
         self._journal("start", job.jid)
         trace_instant("service.job_start", job=job.jid)
@@ -963,11 +1115,15 @@ class JobService:
             if not c.map.finished:
                 tid = c.get_map_task(wid)
                 if isinstance(tid, int) and tid >= 0:
+                    job.grants += 1
+                    self._fleet_grant(wid, job.jid, "map")
                     return {"job": job.jid, "phase": "map", "tid": tid,
                             "attempt": c.report.attempts("map", tid)}
                 continue  # WAIT/NOT_READY: this job's reduce is gated too
             tid = c.get_reduce_task(wid)
             if isinstance(tid, int) and tid >= 0:
+                job.grants += 1
+                self._fleet_grant(wid, job.jid, "reduce")
                 return {"job": job.jid, "phase": "reduce", "tid": tid,
                         "attempt": c.report.attempts("reduce", tid)}
         return WAIT
@@ -1032,7 +1188,11 @@ class JobService:
             if self.draining or len(self.running) > 1:
                 return DONE
             return WAIT
-        return j.coord.get_map_task(wid)
+        tid = j.coord.get_map_task(wid)
+        if isinstance(tid, int) and tid >= 0:
+            j.grants += 1
+            self._fleet_grant(wid, j.jid, "map")
+        return tid
 
     def get_reduce_task(self, wid: int = -1, job=None) -> int:
         j = self._route(job)
@@ -1040,7 +1200,11 @@ class JobService:
             if self.draining or len(self.running) > 1:
                 return DONE
             return WAIT
-        return j.coord.get_reduce_task(wid)
+        tid = j.coord.get_reduce_task(wid)
+        if isinstance(tid, int) and tid >= 0:
+            j.grants += 1
+            self._fleet_grant(wid, j.jid, "reduce")
+        return tid
 
     # The job id rides every task RPC as a TRAILING default arg — the
     # wid/sample wire-compat pattern: a single-job client (or test
@@ -1075,16 +1239,24 @@ class JobService:
         return j.coord.renew_reduce_lease(tid, wid)
 
     def report_map_task_finish(self, tid: int, attempt: int = 0,
-                               wid: int = -1, job=None) -> bool:
+                               wid: int = -1, job=None,
+                               part_bytes=None) -> bool:
+        # ``part_bytes`` is the trailing-default per-partition
+        # intermediate-bytes vector (ISSUE 16) — forwarded to the job's
+        # coordinator, which folds it into partition readiness. Old
+        # 3/4-positional clients stay wire-valid.
         j = self._route(job)
+        self._fleet_release(wid)
         if j is None:
             return True  # job already closed: the report is moot
-        done = j.coord.report_map_task_finish(tid, attempt=attempt, wid=wid)
+        done = j.coord.report_map_task_finish(tid, attempt=attempt, wid=wid,
+                                              part_bytes=part_bytes)
         return done
 
     def report_reduce_task_finish(self, tid: int, attempt: int = 0,
                                   wid: int = -1, job=None) -> bool:
         j = self._route(job)
+        self._fleet_release(wid)
         if j is None:
             return True
         done = j.coord.report_reduce_task_finish(tid, attempt=attempt,
@@ -1106,6 +1278,13 @@ class JobService:
         job.done_s = self.report.uptime_s()
         self.running.pop(job.jid, None)
         self._note_done(job.jid)
+        # Close the fleet view's busy intervals for workers still holding
+        # this job (their leases are revoked; the next grant reopens).
+        self._fleet_accumulate()
+        for ws in self._worker_state.values():
+            if ws["job"] == job.jid:
+                ws["busy_s"] += max(job.done_s - ws["since"], 0.0)
+                ws["job"] = ws["phase"] = None
         if job.coord is not None:
             # Flush the per-job report where mrcheck finds it — the same
             # artifact a single-job coordinator leaves. Snapshot ON the
@@ -1116,6 +1295,9 @@ class JobService:
             # job_status serves the in-memory snapshot, so a status poll
             # never races the write.
             job.report_dict = job.coord.report.to_dict()
+            job.task_seconds = sum(
+                h.total for h in job.coord.report._phase_hist.values()
+            )
             path = os.path.join(job.cfg.work_dir, "job_report.json")
 
             def _write(path=path, doc=job.report_dict, jid=job.jid) -> None:
@@ -1148,6 +1330,12 @@ class JobService:
             self.jobs_completed += 1
             if job.started_s is not None:
                 self._job_wall_hist.add(job.done_s - job.started_s)
+                self._slo_hists(job.priority)["exec_s"].add(
+                    job.done_s - job.started_s
+                )
+            self._slo_hists(job.priority)["e2e_s"].add(
+                max(job.done_s - job.submitted_s, 0.0)
+            )
             self._journal("done", job.jid, state="done",
                           cache_key=job.cache_key, outputs=job.outputs)
         trace_instant("service.job_done", job=job.jid, state=state)
@@ -1164,11 +1352,13 @@ class JobService:
             # Registry hygiene (long-lived service): drop the finished
             # job's labeled series, or the label-sets — and the scrape
             # body — grow one set per job forever while exporting the
-            # corpse's stale last values.
-            for field in ("issued", "done", "in_flight", "expired"):
-                self.registry.gauge(f"job.phase_{field}").remove_labels(
-                    job=job.jid
-                )
+            # corpse's stale last values. The tenant-attribution gauges
+            # (ISSUE 16) reap here too — mrlint rule 16
+            # (unreaped-job-labels) holds this teardown in place.
+            for name in ("job.phase_issued", "job.phase_done",
+                         "job.phase_in_flight", "job.phase_expired",
+                         "job.grants", "job.bytes_in", "job.task_seconds"):
+                self.registry.gauge(name).remove_labels(job=job.jid)
         self._admit_tick()
 
     # ---- observability RPCs + ticks ----
@@ -1191,6 +1381,19 @@ class JobService:
             "cache": self.cache.stats(),
             "queue_wait_s": self._queue_wait_hist.to_dict(),
             "job_wall_s": self._job_wall_hist.to_dict(),
+            # ISSUE 16: per-priority-class SLO hists, the live fleet
+            # utilization/bubble view, and per-job tenant attribution —
+            # the manifest + doctor + `watch` inputs.
+            "slo": {
+                cls: {k: h.to_dict() for k, h in hists.items()}
+                for cls, hists in sorted(self._slo.items())
+            },
+            "fleet_util": self.fleet_view(),
+            "tenants": {
+                jid: self._tenant_row(j)
+                for jid, j in sorted(self.jobs.items())
+                if j.state in ("running", "done", "cancelled", "failed")
+            },
         }
 
     def stats(self) -> dict:
@@ -1257,6 +1460,19 @@ class JobService:
         g.counter("service.cache_evictions").set_total(cache["evictions"])
         g.histogram("service.queue_wait_s").set_hist(self._queue_wait_hist)
         g.histogram("service.job_wall_s").set_hist(self._job_wall_hist)
+        # Per-priority-class SLO histograms (ISSUE 16), cls-labeled on
+        # the scrape endpoint.
+        for cls, hists in self._slo.items():
+            for k, h in hists.items():
+                g.histogram(f"service.slo_{k}").set_hist(h, cls=cls)
+        fl = sv["fleet_util"]
+        g.gauge("fleet.util_frac").set(fl["util_frac"])
+        g.gauge("fleet.bubble_frac").set(fl["bubble_frac"])
+        g.gauge("fleet.bubble_ws").set(fl["bubble_ws"])
+        for wid, row in fl["workers"].items():
+            g.gauge("fleet.worker_util_frac").set(
+                row["util_frac"], wid=wid
+            )
         for job in self.running.values():
             if job.coord is None:
                 continue
@@ -1266,6 +1482,12 @@ class JobService:
                     g.gauge(f"job.phase_{field}").set(
                         ph[field], job=job.jid, phase=name
                     )
+            # Tenant attribution (ISSUE 16), job-labeled and reaped with
+            # the phase gauges when the job finalizes.
+            tr = self._tenant_row(job)
+            g.gauge("job.grants").set(tr["grants"], job=job.jid)
+            g.gauge("job.bytes_in").set(tr["bytes_in"], job=job.jid)
+            g.gauge("job.task_seconds").set(tr["task_seconds"], job=job.jid)
         for method, h in self.report._rpc.items():
             g.counter("rpc.calls").set_total(h.count, method=method)
             g.histogram("rpc.latency_s").set_hist(h, method=method)

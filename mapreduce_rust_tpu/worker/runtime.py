@@ -157,6 +157,15 @@ class Worker:
         # same ``<jid>:`` prefix the service-side coordinator emits (two
         # jobs' ``map:0:1`` chains must never merge into one arrow).
         self._job_ctx: "str | None" = None
+        # Per-reduce-partition intermediate bytes of the map task just
+        # executed (ISSUE 16): stashed by _run_map_task on the executor
+        # thread, popped by _execute_granted and shipped on the finish
+        # report as a TRAILING default RPC field — the coordinator turns
+        # it into partition-readiness instants for the fleet profiler.
+        # MR_FLEET=0 disables the shipping (telemetry only: outputs are
+        # bit-identical either way).
+        self._part_bytes: dict[int, list] = {}
+        self._fleet_enabled = os.environ.get("MR_FLEET", "1") != "0"
 
     def _metrics_tick(self) -> None:
         """Sampler tick on this worker's own registry (the global
@@ -440,14 +449,21 @@ class Worker:
                     parts[r].append((k1, k2, d))
             else:
                 parts[r].append((k1, k2, v))
+        part_bytes = [0] * reduce_n
         for r, rows in parts.items():
             arr = np.asarray(rows, dtype=np.int64).reshape(-1, 3)
+            # 4+4+8 bytes per row as written (k1/k2 uint32, value int64) —
+            # the intermediate-shard payload this map task contributes to
+            # partition r, independent of npz container overhead.
+            part_bytes[r] = 16 * arr.shape[0]
             _atomic_savez(
                 self.work / f"mr-{tid}-{r}.npz",
                 k1=arr[:, 0].astype(np.uint32),
                 k2=arr[:, 1].astype(np.uint32),
                 value=arr[:, 2].astype(np.int64),
             )
+        if self._fleet_enabled:
+            self._part_bytes[tid] = part_bytes
         # Dictionary shards are partitioned by the same app route as the
         # spills, so reduce task r reads exactly its own words —
         # mirroring the mr-{m}-{r} protocol (src/mr/worker.rs:121).
@@ -778,7 +794,15 @@ class Worker:
             log.warning("%s %d: finish report dropped (chaos)", phase, tid)
         else:
             params = [tid, self._attempts.get((phase, tid), 0), self._wid]
-            if job is not None:
+            part_bytes = self._part_bytes.pop(tid, None) \
+                if phase == "map" else None
+            if part_bytes is not None:
+                # Trailing default fields, wid/sample-style: old servers
+                # never see them, old clients stay wire-valid. ``job``
+                # must fill its slot (possibly None) so part_bytes lands
+                # as the 5th positional on both Coordinator and service.
+                params.extend([job, part_bytes])
+            elif job is not None:
                 params.append(job)
             try:
                 await self._call_with_retry(client, report, *params)

@@ -1,0 +1,524 @@
+"""Fleet profiler tests (ISSUE 16).
+
+Three layers, cheapest first: pure interval/timeline math on synthetic
+event logs; a hand-built service root (journal + reports) exercising
+bubble windows, readiness/pipelining and crash tolerance; then real
+runs — an in-process service drive proving the part_bytes wire lands a
+readiness table and the profiler reads it back, plus the OS-process
+crash-forensics leg (chaos SIGKILL) proving the dead-interval
+accounting excludes the crash window instead of calling it idle.
+"""
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import types
+
+import pytest
+
+from mapreduce_rust_tpu.analysis.doctor import service_findings
+from mapreduce_rust_tpu.analysis.mrcheck import run_check
+from mapreduce_rust_tpu.runtime.fleet import (
+    _intersect,
+    _job_intervals,
+    _merge,
+    _subtract,
+    _total,
+    build_fleet_report,
+    compare_baseline,
+    fleet_history_row,
+    format_fleet_report,
+    run_cli,
+)
+from mapreduce_rust_tpu.runtime.histogram import Histogram
+from mapreduce_rust_tpu.runtime.telemetry import JobReport, format_jobs
+
+from tests.test_service import (  # the service harness, reused verbatim
+    TEXTS_A,
+    _cpu_env,
+    _poll_until_done,
+    _spawn_service,
+    _spawn_worker,
+    _submit_cli,
+    free_port,
+    make_cfg,
+    read_wc_outputs,
+    wc_oracle,
+    write_corpus,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Interval arithmetic
+# ---------------------------------------------------------------------------
+
+def test_interval_arithmetic():
+    assert _merge([(3.0, 4.0), (1.0, 2.0), (1.5, 2.5)]) == \
+        [(1.0, 2.5), (3.0, 4.0)]
+    assert _merge([(1.0, 1.0)]) == []  # empty span drops
+    assert _total([(0.0, 1.5), (2.0, 3.0)]) == 2.5
+    assert _subtract([(0.0, 10.0)], [(2.0, 3.0), (4.0, 5.0)]) == \
+        [(0.0, 2.0), (3.0, 4.0), (5.0, 10.0)]
+    assert _subtract([(0.0, 2.0)], [(0.0, 3.0)]) == []
+    assert _intersect([(0.0, 5.0)], [(4.0, 9.0), (6.0, 7.0)]) == [(4.0, 5.0)]
+
+
+def test_job_intervals_busy_dead_and_regrant():
+    events = [
+        {"t": 1.0, "ev": "grant", "phase": "map", "tid": 0, "wid": 0},
+        {"t": 2.0, "ev": "finish", "phase": "map", "tid": 0, "wid": 0},
+        # tid 1: granted to w1, lease expires — dead window on w1.
+        {"t": 1.0, "ev": "grant", "phase": "map", "tid": 1, "wid": 1},
+        {"t": 4.0, "ev": "expire", "phase": "map", "tid": 1, "wid": 1},
+        # re-grant to w0, finishes.
+        {"t": 4.0, "ev": "grant", "phase": "map", "tid": 1, "wid": 0},
+        {"t": 5.0, "ev": "finish", "phase": "map", "tid": 1, "wid": 0},
+        # tid 2: re-grant OVER a still-open grant (expiry row lost) —
+        # the first attempt reads dead up to the re-grant.
+        {"t": 2.0, "ev": "grant", "phase": "reduce", "tid": 2, "wid": 1},
+        {"t": 6.0, "ev": "grant", "phase": "reduce", "tid": 2, "wid": 0},
+        {"t": 7.0, "ev": "finish", "phase": "reduce", "tid": 2, "wid": 0},
+        # tid 3: open at end of log — dead to the window end.
+        {"t": 7.5, "ev": "grant", "phase": "reduce", "tid": 3, "wid": 1},
+    ]
+    rows, t_max = _job_intervals("j1", events, base=10.0, end_hint=19.0)
+    assert t_max == 7.5
+    by = {(r["state"], r["wid"], r["tid"]): (r["t0"], r["t1"]) for r in rows}
+    assert by[("busy", 0, 0)] == (11.0, 12.0)   # rebased by +10
+    assert by[("dead", 1, 1)] == (11.0, 14.0)
+    assert by[("busy", 0, 1)] == (14.0, 15.0)
+    assert by[("dead", 1, 2)] == (12.0, 16.0)   # re-grant killed it
+    assert by[("busy", 0, 2)] == (16.0, 17.0)
+    assert by[("dead", 1, 3)] == (17.5, 19.0)   # open at log end
+    assert all(r["job"] == "j1" for r in rows)
+
+
+# ---------------------------------------------------------------------------
+# Partition readiness (the JobReport side of the part_bytes wire)
+# ---------------------------------------------------------------------------
+
+def test_record_partition_ready_accumulates_and_validates():
+    rep = JobReport(job_id="j1")
+    rep.record_partition_ready(0, [16, 0, 32])
+    rep.record_partition_ready(1, [0, 48, 16])
+    parts = rep.partitions_summary()
+    assert parts["0"]["bytes"] == 16 and parts["0"]["shards"] == 2
+    assert parts["1"]["bytes"] == 48
+    assert parts["2"]["bytes"] == 48
+    # ready_s only set by a contributing (b > 0) shard.
+    assert parts["1"]["ready_s"] is not None
+    # A malformed vector (bool/non-numeric element) is rejected WHOLE —
+    # no partial readiness from a corrupt report.
+    rep.record_partition_ready(2, [16, True, 16])
+    rep.record_partition_ready(3, "nope")
+    assert rep.partitions_summary() == parts
+    # The table rides the report snapshot.
+    assert json.loads(json.dumps(rep.to_dict()))["partitions"]["0"][
+        "bytes"] == 16
+
+
+def test_record_partition_ready_caps_remote_vectors():
+    rep = JobReport(job_id="j1")
+    rep.record_partition_ready(0, [16] * 5000)  # over PARTITIONS_CAP
+    assert rep.partitions_summary() == {}
+
+
+# ---------------------------------------------------------------------------
+# Synthetic service root: bubbles, pipelining, crash tolerance
+# ---------------------------------------------------------------------------
+
+def _write_service_root(root, journal_rows, reports):
+    root.mkdir(parents=True, exist_ok=True)
+    with open(root / "service.journal", "w") as f:
+        for row in journal_rows:
+            f.write(json.dumps(row) + "\n")
+    for jid, rep in reports.items():
+        d = root / f"job-{jid}"
+        d.mkdir()
+        (d / "job_report.json").write_text(
+            json.dumps({"kind": "job_report", "report": rep})
+        )
+
+
+def test_build_fleet_report_synthetic_service(tmp_path):
+    root = tmp_path / "work"
+    journal = [
+        {"op": "submit", "job": "j1", "t": 0.0, "priority": 0,
+         "spec": {"app": "word_count"}},
+        {"op": "start", "job": "j1", "t": 0.5},
+        {"op": "done", "job": "j1", "t": 10.5, "state": "done"},
+        # j2 queued behind j1 for 4s — a bubble window.
+        {"op": "submit", "job": "j2", "t": 2.0, "priority": -1,
+         "spec": {"app": "word_count"}},
+        {"op": "start", "job": "j2", "t": 6.0},
+        {"op": "done", "job": "j2", "t": 12.0, "state": "done"},
+        # j3: cache hit — done without start, never a bubble.
+        {"op": "submit", "job": "j3", "t": 3.0},
+        {"op": "done", "job": "j3", "t": 3.0, "state": "done",
+         "cached": True},
+    ]
+    # j1 (epoch 0.5): two maps land at 2.0/4.0, reduce 0 starts 5.0 —
+    # barrier window (2.5, 4.5) on the service axis; partition 0 ready
+    # at 4.0 → pipelining gap 1.0s.
+    j1 = {
+        "job": "j1",
+        "events": [
+            {"t": 0.5, "ev": "grant", "phase": "map", "tid": 0, "wid": 0},
+            {"t": 2.0, "ev": "finish", "phase": "map", "tid": 0, "wid": 0},
+            {"t": 0.5, "ev": "grant", "phase": "map", "tid": 1, "wid": 1},
+            {"t": 4.0, "ev": "finish", "phase": "map", "tid": 1, "wid": 1},
+            {"t": 5.0, "ev": "grant", "phase": "reduce", "tid": 0, "wid": 0},
+            {"t": 9.0, "ev": "finish", "phase": "reduce", "tid": 0,
+             "wid": 0},
+        ],
+        "totals": {"map": 2, "reduce": 1},
+        "partitions": {"0": {"bytes": 64, "shards": 2, "ready_s": 4.0}},
+    }
+    # j2 (epoch 6.0): one map, one reduce on w1 — no barrier (single
+    # map finish), no partitions table (old-client job).
+    j2 = {
+        "job": "j2",
+        "events": [
+            {"t": 0.2, "ev": "grant", "phase": "map", "tid": 0, "wid": 1},
+            {"t": 2.0, "ev": "finish", "phase": "map", "tid": 0, "wid": 1},
+            {"t": 2.5, "ev": "grant", "phase": "reduce", "tid": 0, "wid": 1},
+            {"t": 5.5, "ev": "finish", "phase": "reduce", "tid": 0,
+             "wid": 1},
+        ],
+        "totals": {"map": 1, "reduce": 1},
+    }
+    _write_service_root(root, journal, {"j1": j1, "j2": j2})
+    rep = build_fleet_report(str(root))
+    assert rep["mode"] == "service" and rep["fleet"]["jobs"] == 3
+    jobs = rep["jobs"]
+    assert jobs["j1"]["barrier_window"] == (2.5, 4.5)
+    assert jobs["j1"]["pipelining_opportunity_s"] == pytest.approx(1.0)
+    assert jobs["j1"]["partitions"]["0"]["gap_s"] == pytest.approx(1.0)
+    assert jobs["j2"]["queue_wait_s"] == pytest.approx(4.0)
+    assert "barrier_window" not in jobs["j2"]
+    assert jobs["j3"]["cached"] and jobs["j3"]["queue_wait_s"] == 0.0
+    # j1's own 0.5s admission wait, then j2's queued span (2,6) merged
+    # with j1's barrier window (2.5,4.5).
+    assert rep["bubble_windows"] == [(0.0, 0.5), (2.0, 6.0)]
+    # Fault-free: zero dead worker-seconds, busy+idle == active.
+    f = rep["fleet"]
+    assert f["dead_ws"] == 0.0 and f["bubble_ws"] > 0.0
+    assert f["busy_ws"] + f["idle_ws"] == pytest.approx(f["active_ws"])
+    assert f["pipelining_opportunity_s"] == pytest.approx(1.0)
+    assert fleet_history_row(rep) == {
+        "fleet_bubble_frac": f["bubble_frac"],
+        "fleet_util_frac": f["util_frac"],
+        "pipelining_opportunity_s": 1.0,
+    }
+    # Text rendering never throws and names the numbers.
+    text = format_fleet_report(rep, verbose=True)
+    assert "pipelining opportunity" in text and "w0" in text
+
+
+def test_build_fleet_report_crash_tolerant(tmp_path):
+    root = tmp_path / "work"
+    _write_service_root(
+        root,
+        [{"op": "submit", "job": "j1", "t": 0.0},
+         {"op": "start", "job": "j1", "t": 0.2}],
+        {},
+    )
+    # Torn journal tail + a half-written report + a report-less job dir.
+    with open(root / "service.journal", "a") as f:
+        f.write('{"op": "done", "job": "j1"')  # crashed mid-append
+    (root / "job-j1").mkdir()
+    (root / "job-j1" / "job_report.json").write_text('{"report": {"ev')
+    (root / "job-j2").mkdir()
+    rep = build_fleet_report(str(root))
+    assert rep["jobs"]["j1"]["partial"]
+    assert any("torn" in e for e in rep["errors"])
+    assert rep["fleet"]["util_frac"] == 0.0  # degraded, not thrown
+
+
+def test_fleet_cli_json_baseline_and_exit_codes(tmp_path, capsys):
+    root = tmp_path / "work"
+    _write_service_root(
+        root,
+        [{"op": "submit", "job": "j1", "t": 0.0},
+         {"op": "start", "job": "j1", "t": 1.0},
+         {"op": "done", "job": "j1", "t": 3.0, "state": "done"}],
+        {"j1": {
+            "job": "j1",
+            "events": [
+                {"t": 0.0, "ev": "grant", "phase": "map", "tid": 0,
+                 "wid": 0},
+                {"t": 1.5, "ev": "finish", "phase": "map", "tid": 0,
+                 "wid": 0},
+            ],
+            "totals": {"map": 1},
+        }},
+    )
+    ns = types.SimpleNamespace(target=str(root), format="json",
+                               baseline=None, verbose=False)
+    assert run_cli(ns) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["kind"] == "fleet_report" and doc["fleet"]["workers"] == 1
+    # Baseline leg: a much-lower baseline bubble regresses (exit 1)…
+    base = dict(doc, fleet=dict(doc["fleet"], bubble_frac=0.0))
+    cur = dict(doc, fleet=dict(doc["fleet"], bubble_frac=0.5))
+    assert compare_baseline(cur, base)["regressed"]
+    # …and identical reports never do (guard band).
+    assert not compare_baseline(doc, doc)["regressed"]
+    bpath = tmp_path / "base.json"
+    bpath.write_text(json.dumps(base))
+    ns2 = types.SimpleNamespace(target=str(root), format="text",
+                                baseline=str(bpath), verbose=False)
+    assert run_cli(ns2) == 0
+    capsys.readouterr()
+    # Exit 2: bad target / bad baseline file.
+    assert run_cli(types.SimpleNamespace(
+        target=str(tmp_path / "nope"), format="text")) == 2
+    bad = tmp_path / "notafleet.json"
+    bad.write_text("{}")
+    assert run_cli(types.SimpleNamespace(
+        target=str(root), format="text", baseline=str(bad))) == 2
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# Doctor findings + watch table
+# ---------------------------------------------------------------------------
+
+def _slo(low_waits, high_waits):
+    lo, hi = Histogram(), Histogram()
+    for v in low_waits:
+        lo.add(v)
+    for v in high_waits:
+        hi.add(v)
+    return {"low": {"queue_wait_s": lo.to_dict()},
+            "high": {"queue_wait_s": hi.to_dict()}}
+
+
+def test_doctor_fleet_findings():
+    sv = {
+        "queued": 0,
+        "fleet_util": {
+            "active_ws": 20.0, "bubble_ws": 8.0, "bubble_frac": 0.4,
+            "util_frac": 0.5,
+            "workers": {"0": {"util_frac": 0.9},
+                        "1": {"util_frac": 0.05},
+                        "2": {"util_frac": 0.05},
+                        "3": {"util_frac": 0.2, "drained": True}},
+        },
+        "slo": _slo(low_waits=[5.0] * 8, high_waits=[0.1] * 8),
+    }
+    codes = {f["code"] for f in service_findings(sv)}
+    assert {"barrier-bubble", "fleet-imbalance",
+            "admission-starvation"} <= codes
+    # Below the floors: a tiny observation window or balanced fleet
+    # stays silent.
+    quiet = {
+        "queued": 0,
+        "fleet_util": {"active_ws": 1.0, "bubble_frac": 0.9,
+                       "workers": {}},
+        "slo": _slo(low_waits=[0.2] * 8, high_waits=[0.1] * 8),
+    }
+    assert not {f["code"] for f in service_findings(quiet)} & {
+        "barrier-bubble", "fleet-imbalance", "admission-starvation"}
+
+
+def test_format_jobs_renders_fleet_columns():
+    view = {
+        "service": {
+            "running": 1, "queued": 0, "done": 0, "workers": 2,
+            "inflight_bytes": 0, "budget_bytes": 1 << 20,
+            "cache": {}, "uptime_s": 9.0,
+            "fleet_util": {
+                "util_frac": 0.62, "bubble_frac": 0.1,
+                "workers": {
+                    "0": {"util_frac": 0.8, "grants": 4, "job": "j1",
+                          "phase": "map", "busy_s": 5.0},
+                    "1": {"util_frac": 0.44, "grants": 2, "busy_s": 2.0,
+                          "drained": True},
+                },
+            },
+        },
+        "jobs": [],
+    }
+    text = format_jobs(view)
+    assert "fleet: util 62%" in text
+    assert "j1:map" in text and "(drained)" in text
+    # Absent on pre-fleet services: the table renders without the block.
+    del view["service"]["fleet_util"]
+    assert "fleet:" not in format_jobs(view)
+
+
+# ---------------------------------------------------------------------------
+# Real runs: in-process wire check, then OS-process crash forensics
+# ---------------------------------------------------------------------------
+
+def _drive_two_jobs(tmp_path, tag):
+    """One in-process service run (2 workers, max_jobs=1 so the second
+    job queues) — returns (work_root, out_root, jids)."""
+    from mapreduce_rust_tpu.coordinator.server import CoordinatorClient
+    from mapreduce_rust_tpu.service.server import JobService
+    from mapreduce_rust_tpu.worker.runtime import ServiceWorker
+
+    docs = write_corpus(tmp_path / f"in-{tag}", TEXTS_A)
+    cfg = make_cfg(
+        tmp_path, input_dir=docs, map_n=3, reduce_n=3,
+        work_dir=str(tmp_path / f"work-{tag}"),
+        output_dir=str(tmp_path / f"out-{tag}"),
+        service_max_jobs=1,
+    )
+
+    async def go():
+        svc = JobService(cfg)
+        serve = asyncio.create_task(svc.serve())
+        await asyncio.sleep(0.2)
+        client = CoordinatorClient(cfg.host, cfg.port, timeout_s=15.0)
+        await client.connect()
+        jids = []
+        for spec in (
+            {"app": "word_count", "input_dir": docs, "reduce_n": 3},
+            {"app": "word_count", "input_dir": docs, "reduce_n": 2},
+        ):
+            res = await client.call("submit_job", spec)
+            assert res["ok"], res
+            jids.append(res["job"])
+        ws = [ServiceWorker(cfg) for _ in range(2)]
+        tasks = [asyncio.create_task(w.run()) for w in ws]
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            st = await client.call("stats")
+            states = {j["job"]: j["state"] for j in st["jobs"]}
+            if all(states.get(j) == "done" for j in jids):
+                break
+            await asyncio.sleep(0.1)
+        else:
+            raise AssertionError(f"not done: {states}")
+        view = await client.call("stats")
+        await client.call("shutdown")
+        await client.close()
+        await asyncio.wait_for(asyncio.gather(*tasks), timeout=30)
+        await asyncio.wait_for(serve, timeout=30)
+        return jids, view
+
+    jids, view = asyncio.run(go())
+    return cfg.work_dir, cfg.output_dir, jids, view
+
+
+def test_fleet_report_on_real_service_run(tmp_path):
+    """The whole wire, live: worker part_bytes → coordinator readiness
+    table → job_report.json → fleet report with nonzero utilization and
+    a per-job pipelining opportunity; the stats view carries the live
+    fleet series and per-class SLO histograms; mrcheck (with the new
+    job-lifecycle invariant) stays green over the root."""
+    work, out, jids, view = _drive_two_jobs(tmp_path, "live")
+    # Live service view: fleet series + SLO histograms + tenant rows.
+    sv = view["service"]
+    assert sv["fleet_util"]["workers"], sv["fleet_util"]
+    assert "normal" in sv["slo"]
+    e2e = Histogram.from_dict(sv["slo"]["normal"]["e2e_s"])
+    assert e2e.count == 2
+    assert set(jids) <= set(sv["tenants"])
+    assert all(t["grants"] > 0 for t in sv["tenants"].values())
+    # The readiness table landed in each job's report artifact.
+    for jid in jids:
+        with open(os.path.join(work, f"job-{jid}", "job_report.json")) as f:
+            rep = json.load(f)["report"]
+        assert rep["partitions"], f"no readiness table for {jid}"
+        assert all(s["ready_s"] is not None
+                   for s in rep["partitions"].values())
+    rep = build_fleet_report(work)
+    f = rep["fleet"]
+    assert rep["mode"] == "service" and f["workers"] == 2
+    assert f["busy_ws"] > 0 and f["util_frac"] > 0
+    assert f["dead_ws"] == 0.0  # fault-free run
+    # The reduce phase started strictly after the map barrier on every
+    # job — the pipelining headroom is real and positive.
+    assert f["pipelining_opportunity_s"] > 0
+    for jid in jids:
+        assert rep["jobs"][jid]["pipelining_opportunity_s"] > 0
+    # The second job queued behind max_jobs=1: its wait is a bubble.
+    assert rep["jobs"][jids[1]]["queue_wait_s"] > 0
+    assert f["bubble_ws"] > 0
+    doc = run_check(work)
+    assert doc["ok"], doc["violations"]
+    assert doc["checked"]["service_journal_lines"] >= 6
+
+
+def test_fleet_off_is_bit_identical(tmp_path, monkeypatch):
+    """MR_FLEET=0 drops the part_bytes telemetry; the OUTPUTS must not
+    move a byte (profiling is observation, never participation)."""
+    from tests.test_service import output_bytes
+
+    monkeypatch.setenv("MR_FLEET", "1")
+    work_on, out_on, jids_on, _ = _drive_two_jobs(tmp_path, "on")
+    monkeypatch.setenv("MR_FLEET", "0")
+    work_off, out_off, jids_off, _ = _drive_two_jobs(tmp_path, "off")
+    for j_on, j_off in zip(jids_on, jids_off):
+        assert output_bytes(
+            os.path.join(out_on, f"job-{j_on}")
+        ) == output_bytes(os.path.join(out_off, f"job-{j_off}"))
+    # And the gate really gated: no readiness tables written.
+    with open(os.path.join(work_off, f"job-{jids_off[0]}",
+                           "job_report.json")) as f:
+        assert "partitions" not in json.load(f)["report"]
+
+
+def test_fleet_crash_forensics_chaos_kill(tmp_path):
+    """Satellite: chaos-SIGKILL a worker mid-map under the OS-process
+    service, then point the fleet CLI at the work root. The killed
+    attempt must surface as a dead interval on its worker's timeline —
+    excluded from the idle (and therefore bubble) accounting — and the
+    report still renders end to end."""
+    docs = write_corpus(tmp_path / "in", TEXTS_A)
+    port = free_port()
+    svc = _spawn_service(docs, tmp_path, port, extra=("--max-jobs", "2"))
+    # The chaos worker runs ALONE first, so it deterministically draws
+    # map task 1 and dies mid-attempt; the clean worker spawns after the
+    # kill and recovers the job.
+    chaos_w = _spawn_worker(docs, tmp_path, port,
+                            chaos="seed=2;kill:map:1")
+    clean_w = None
+    try:
+        r1 = _submit_cli(docs, port, reduce_n=3)
+        chaos_w.wait(timeout=60)  # SIGKILLed itself on map:1
+        clean_w = _spawn_worker(docs, tmp_path, port)
+        states = asyncio.run(
+            _poll_until_done(port, [r1["job"]], timeout_s=120)
+        )
+        assert all(s == "done" for s in states.values())
+        svc.wait(timeout=30)
+    finally:
+        for p in [svc, chaos_w, clean_w]:
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait()
+    assert read_wc_outputs(
+        tmp_path / "out" / f"job-{r1['job']}"
+    ) == wc_oracle(TEXTS_A)
+    rep = build_fleet_report(str(tmp_path / "work"))
+    dead_rows = [r for r in rep["timeline"] if r["state"] == "dead"]
+    assert dead_rows, "SIGKILLed attempt left no dead interval"
+    assert rep["fleet"]["dead_ws"] > 0
+    # The crash window leaves the denominator: for every worker,
+    # busy + idle + dead == present, and bubble ⊆ idle (never dead).
+    for w in rep["workers"].values():
+        assert w["busy_s"] + w["idle_s"] + w["dead_s"] == \
+            pytest.approx(w["present_s"], abs=0.01)
+        assert w["bubble_s"] <= w["idle_s"] + 1e-9
+    # The CLI renders the forensics without raising.
+    out = subprocess.run(
+        [sys.executable, "-m", "mapreduce_rust_tpu", "fleet",
+         str(tmp_path / "work")],
+        env=_cpu_env(), cwd=REPO, capture_output=True, text=True,
+        timeout=60,
+    )
+    assert out.returncode == 0, (out.returncode, out.stdout, out.stderr)
+    assert "dead interval" in out.stdout
+    # And the run stays conformant — expiries are not violations.
+    doc = run_check(str(tmp_path / "work"))
+    assert doc["ok"], doc["violations"]

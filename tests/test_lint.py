@@ -1445,3 +1445,84 @@ def test_range_partition_silent_on_unresolvable_value(tmp_path):
             return range_partition(keys, spl)
     """)
     assert fired == []
+
+
+# ---------------------------------------------------------------------------
+# Rule 16: unreaped-job-labels (ISSUE 16) — job=-labeled metric writes
+# need a reachable remove_labels reap somewhere in the owning class.
+# ---------------------------------------------------------------------------
+
+def test_unreaped_job_labels_fires_without_reap(tmp_path):
+    fired, report = program_rules_fired(tmp_path, """
+        class Service:
+            def __init__(self, registry):
+                self.registry = registry
+
+            def metrics_tick(self, jobs):
+                g = self.registry
+                for job in jobs:
+                    g.gauge("job.grants").set(job.grants, job=job.jid)
+                    g.gauge("job.bytes_in").set(job.bytes_in, job=job.jid)
+    """)
+    assert fired == ["unreaped-job-labels"]
+    msg = report.findings[0].message
+    assert "Service" in msg and "remove_labels" in msg
+
+
+def test_unreaped_job_labels_silent_with_class_local_reap(tmp_path):
+    # The shipped shape: the tick registers, _finalize_job reaps — both
+    # methods of the same class.
+    fired, _ = program_rules_fired(tmp_path, """
+        class Service:
+            def __init__(self, registry):
+                self.registry = registry
+
+            def metrics_tick(self, jobs):
+                for job in jobs:
+                    self.registry.gauge("job.grants").set(
+                        job.grants, job=job.jid
+                    )
+
+            def finalize_job(self, job):
+                for name in ("job.grants",):
+                    self.registry.gauge(name).remove_labels(job=job.jid)
+    """)
+    assert fired == []
+
+
+def test_unreaped_job_labels_silent_when_reap_is_reachable(tmp_path):
+    # The reap may live in a helper the teardown method calls — the
+    # sanction follows the sync call closure, not just the class body.
+    fired, _ = program_rules_fired(tmp_path, """
+        def reap_job_series(registry, jid):
+            registry.gauge("job.grants").remove_labels(job=jid)
+
+        class Service:
+            def __init__(self, registry):
+                self.registry = registry
+
+            def metrics_tick(self, jobs):
+                for job in jobs:
+                    self.registry.gauge("job.grants").set(
+                        job.grants, job=job.jid
+                    )
+
+            def finalize_job(self, job):
+                reap_job_series(self.registry, job.jid)
+    """)
+    assert fired == []
+
+
+def test_unreaped_job_labels_ignores_unlabeled_and_free_functions(tmp_path):
+    # Unlabeled writes carry no cardinality hazard; free functions have
+    # no teardown seam to anchor a reap to — both stay silent.
+    fired, _ = program_rules_fired(tmp_path, """
+        def tick(registry, jobs):
+            for job in jobs:
+                registry.gauge("job.grants").set(job.grants, job=job.jid)
+
+        class Worker:
+            def tick(self, registry):
+                registry.gauge("worker.busy").set(1.0)
+    """)
+    assert fired == []
