@@ -1589,7 +1589,8 @@ _CHAOS_TEXTS = [
 
 def _chaos_cluster(name: str, work_root: pathlib.Path, chaos_spec: str | None,
                    speculate: bool, timeout_s: int = 120,
-                   trace: bool = False, app: str = "word_count") -> dict:
+                   trace: bool = False, app: str = "word_count",
+                   sched: str = "fifo") -> dict:
     """One chaos leg: coordinator + 2 worker OS processes over TCP (the
     REAL binaries — the recovery paths under test live in the real
     renewal/report loops, not a harness reimplementation). Faults ride in
@@ -1615,6 +1616,12 @@ def _chaos_cluster(name: str, work_root: pathlib.Path, chaos_spec: str | None,
         "--lease-timeout", "2.0", "--lease-check-period", "0.3",
         "--renew-period", "0.3", "--poll-retry", "0.05",
     ]
+    if sched != "fifo":
+        # --sched rides in `common` so the coordinator and BOTH workers
+        # agree on the mode (a pipelined worker against a FIFO
+        # coordinator would just see NOT_READY, but measuring that
+        # mismatch is not the point of any leg).
+        common += ["--sched", sched]
     if trace:
         common += ["--trace", str(leg / "trace.json")]
     coord_args = ["--worker-n", "2", "--manifest", str(manifest), *common]
@@ -1639,7 +1646,7 @@ def _chaos_cluster(name: str, work_root: pathlib.Path, chaos_spec: str | None,
         )
         for _ in range(2)
     ]
-    result: dict = {"scenario": name, "speculate": speculate}
+    result: dict = {"scenario": name, "speculate": speculate, "sched": sched}
     try:
         rc = coord.wait(timeout=timeout_s)
         result["wall_s"] = round(time.perf_counter() - t0, 3)
@@ -1691,19 +1698,30 @@ def chaos_legs() -> None:
 
     work_root = BENCH_DIR / "chaos"
     shutil.rmtree(work_root, ignore_errors=True)
-    legs: list[tuple[str, str | None, bool]] = [("baseline", None, False)]
+    legs: list[tuple[str, str | None, bool, str]] = [
+        ("baseline", None, False, "fifo"),
+    ]
     for name, spec in SCENARIOS.items():
         if name == "slow_scan":
-            legs.append(("slow_scan-nospec", spec, False))
-            legs.append(("slow_scan-spec", spec, True))
+            legs.append(("slow_scan-nospec", spec, False, "fifo"))
+            legs.append(("slow_scan-spec", spec, True, "fifo"))
         else:
-            legs.append((name, spec, False))
+            legs.append((name, spec, False, "fifo"))
+    # Pipelined pair (ISSUE 17 satellite): the same cluster under
+    # --sched pipeline, fault-free and with the seeded kill:map SIGKILL.
+    # Per-partition reduce release must survive a mid-map re-execution
+    # (readiness retracted on lease expiry, re-established by the rerun)
+    # and both legs must stay bit-identical to the fault-free FIFO
+    # baseline — which also proves fifo-vs-pipeline output identity and,
+    # by transitivity, identity with the FIFO kill leg above.
+    legs.append(("baseline-pipeline", None, False, "pipeline"))
+    legs.append(("kill-pipeline", SCENARIOS["kill"], False, "pipeline"))
     baseline_outputs = None
     baseline_wall = None
     rows = []
     ok = True
-    for name, spec, speculate in legs:
-        r = _chaos_cluster(name, work_root, spec, speculate)
+    for name, spec, speculate, sched in legs:
+        r = _chaos_cluster(name, work_root, spec, speculate, sched=sched)
         outputs = r.pop("outputs")
         if name == "baseline":
             baseline_outputs, baseline_wall = outputs, r.get("wall_s")
@@ -1760,6 +1778,7 @@ def chaos_legs() -> None:
             "chaos_recovery_cost_s": r.get("recovery_cost_s"),
             "chaos_bit_identical": r["bit_identical"],
             "chaos_speculate": speculate,
+            "chaos_sched": sched,
             "chaos_mrcheck": r["mrcheck"],
         })
     # Slow-disk pair (ISSUE 11 satellite): the seeded per-spill write
@@ -1858,35 +1877,42 @@ def chaos_legs() -> None:
         raise SystemExit(1)
 
 
-def service_leg(k_jobs: int | None = None) -> None:
-    """``bench.py --service-leg``: continuous-traffic throughput of the
-    multi-tenant job service (ISSUE 14). One OS-process service + 2
-    service workers; a stream of K mixed submissions (three distinct
-    (app, corpus) triples cycled, so repeats past the first cycle are
-    cache hits) drives the admission queue; the leg records jobs/minute,
-    queue-wait p95 and the cache hit rate into .bench/history.jsonl —
-    ``doctor trend`` watches jobs/minute (bad = down: the control plane
-    itself got slower). mrcheck runs over the service work root (every
-    job's journal + report) and a violation fails the leg loudly, the
-    --chaos doctrine. Prints ONE JSON line; exit 1 on failure."""
+def _service_run(k_jobs: int, sched: str, root: pathlib.Path,
+                 docs_n: int = 3, scale: int = 1) -> dict:
+    """One service cluster run over the mixed two-wave matrix: one
+    OS-process service + 2 service workers under ``--sched {sched}``; a
+    stream of K mixed submissions (three distinct (app, corpus) triples
+    cycled, so repeats past the first cycle are cache hits) drives the
+    admission queue. Measures jobs/minute, queue-wait p95 and the cache
+    hit rate; mrcheck runs over the service work root (every job's
+    journal + report) and a violation fails the run loudly, the --chaos
+    doctrine; the fleet profiler (ISSUE 16) adds the bubble fraction and
+    pipelining opportunity. Returns the result dict WITHOUT printing or
+    touching history — shared by --service-leg (one run) and --sched-ab
+    (the ISSUE 17 fifo-vs-pipeline pair)."""
     import asyncio
     import shutil
 
     from mapreduce_rust_tpu.analysis.mrcheck import run_check
     from mapreduce_rust_tpu.runtime.histogram import Histogram
 
-    k_jobs = k_jobs or int(os.environ.get("BENCH_SERVICE_JOBS", "12"))
-    root = BENCH_DIR / "service"
     shutil.rmtree(root, ignore_errors=True)
     corpora = []
     for ci in range(3):
         d = root / f"corpus-{ci}"
         d.mkdir(parents=True)
-        for i, t in enumerate(_CHAOS_TEXTS):
+        # ``docs_n``/``scale`` size the per-job map wave and per-task
+        # weight: the default is the historical tiny matrix (trend-series
+        # continuity); the sched A/B needs real phase windows or the
+        # scheduling delta drowns in process startup.
+        for i in range(max(3, docs_n)):
+            t = _CHAOS_TEXTS[i % len(_CHAOS_TEXTS)] * max(1, scale)
             # Distinct corpora (distinct digests): a per-corpus marker
-            # token repeated ci+1 times.
+            # token repeated ci+1 times; a per-doc token keeps repeated
+            # texts from collapsing into identical files.
             (d / f"doc-{i}.txt").write_bytes(
-                t + (f"corpusmark{ci} " * (ci + 1)).encode()
+                t + f"doc{i} ".encode()
+                + (f"corpusmark{ci} " * (ci + 1)).encode()
             )
         corpora.append(str(d))
     # The mixed stream: three distinct (app, corpus, config) triples —
@@ -1905,6 +1931,9 @@ def service_leg(k_jobs: int | None = None) -> None:
         "--work", str(root / "work"), "--port", str(port),
         "--lease-timeout", "5.0", "--lease-check-period", "0.3",
         "--renew-period", "0.3", "--poll-retry", "0.05",
+        # Scheduling mode rides `common` so the service AND its workers
+        # agree; per-job coordinators inherit it through _job_cfg.
+        "--sched", sched,
     ]
     svc = subprocess.Popen(
         [sys.executable, "-m", "mapreduce_rust_tpu", "service",
@@ -1922,7 +1951,7 @@ def service_leg(k_jobs: int | None = None) -> None:
     result: dict = {
         "metric": "job service: K mixed submissions, jobs/minute "
                   "(service+2 workers, host engine, cpu)",
-        "unit": "jobs/min", "k_jobs": k_jobs,
+        "unit": "jobs/min", "k_jobs": k_jobs, "sched": sched,
     }
     ok = True
     try:
@@ -2045,18 +2074,125 @@ def service_leg(k_jobs: int | None = None) -> None:
     except Exception as e:
         result["fleet_error"] = repr(e)
     result["ok"] = ok
+    return result
+
+
+def service_leg(k_jobs: int | None = None) -> None:
+    """``bench.py --service-leg``: continuous-traffic throughput of the
+    multi-tenant job service (ISSUE 14) — one _service_run over the
+    mixed two-wave matrix, recorded into .bench/history.jsonl; ``doctor
+    trend`` watches jobs/minute (bad = down: the control plane itself
+    got slower). BENCH_SERVICE_SCHED=pipeline runs the single leg under
+    the pipelined scheduler; the A/B pair is ``--sched-ab``. Prints ONE
+    JSON line; exit 1 on failure."""
+    k_jobs = k_jobs or int(os.environ.get("BENCH_SERVICE_JOBS", "12"))
+    sched = os.environ.get("BENCH_SERVICE_SCHED", "fifo")
+    result = _service_run(k_jobs, sched, BENCH_DIR / "service")
     _append_history({
         "metric": result["metric"],
         "value": None,  # jobs/min has its own trend series below
         "unit": "jobs/min",
         "platform": "cpu",
+        "service_sched": sched,
         "service_jobs_per_min": result.get("value"),
         "service_queue_wait_p95_s": result.get("queue_wait_p95_s"),
         "service_cache_hit_rate": result.get("cache_hit_rate"),
         "service_k_jobs": k_jobs,
         "service_mrcheck": result.get("mrcheck"),
-        **fleet_row,
+        **{k: v for k, v in result.items()
+           if k.startswith(("fleet_", "pipelining_"))},
         "error": result.get("error"),
+    })
+    print(json.dumps(result))
+    if not result["ok"]:
+        raise SystemExit(1)
+
+
+def service_sched_ab(k_jobs: int | None = None) -> None:
+    """``bench.py --service-leg --sched-ab`` (ISSUE 17 acceptance): the
+    SAME mixed two-wave matrix under ``--sched fifo`` vs ``--sched
+    pipeline``, sides INTERLEAVED per repeat so machine drift hits both
+    equally (the dispatch_ab_pair doctrine). Best repeat per side by
+    jobs/min; one JSON line + ONE history row carrying both sides — the
+    pipeline side feeds the watched series (service_jobs_per_min,
+    fleet_bubble_frac, pipelining_opportunity_s: the numbers the
+    scheduling ROADMAP item is struck with). Correctness (all jobs done,
+    exact cache-hit count, mrcheck clean) is enforced per run and fails
+    the pair loudly; the throughput DELTA is recorded, not gated — a
+    noisy shared machine must not turn a perf probe into a flaky
+    oracle."""
+    k_jobs = k_jobs or int(os.environ.get("BENCH_SERVICE_JOBS", "12"))
+    repeats = int(os.environ.get("BENCH_SCHED_AB_REPEATS", "1"))
+    # Heavier matrix than the single leg's: enough docs (map tasks) and
+    # bytes per task that phase windows are real and barrier bubbles
+    # exist for the pipeline side to fill.
+    docs_n = int(os.environ.get("BENCH_SCHED_AB_DOCS", "12"))
+    scale = int(os.environ.get("BENCH_SCHED_AB_SCALE", "8"))
+    sides: dict = {"fifo": [], "pipeline": []}
+    ok = True
+    for rep in range(repeats):
+        for sched in ("fifo", "pipeline"):  # interleaved: drift hits both
+            try:
+                res = _service_run(
+                    k_jobs, sched, BENCH_DIR / f"service-ab-{sched}",
+                    docs_n=docs_n, scale=scale,
+                )
+            except Exception as e:
+                res = {"ok": False, "error": repr(e), "sched": sched}
+            ok = ok and bool(res.get("ok"))
+            sides[sched].append(res)
+            print(
+                f"sched-ab {sched}[{rep}]: jobs/min={res.get('value')} "
+                f"queue_p95={res.get('queue_wait_p95_s')}s "
+                f"bubble={res.get('fleet_bubble_frac')} "
+                f"pipelining_opp={res.get('pipelining_opportunity_s')}s "
+                f"ok={res.get('ok')}",
+                file=sys.stderr,
+            )
+
+    def best(rows: list) -> dict:
+        scored = [r for r in rows if r.get("value")]
+        return max(scored or rows, key=lambda r: r.get("value") or 0.0)
+
+    f, p = best(sides["fifo"]), best(sides["pipeline"])
+    pick = lambda r: {  # noqa: E731
+        k: r.get(k) for k in (
+            "value", "wall_s", "queue_wait_p95_s", "cache_hit_rate",
+            "fleet_bubble_frac", "fleet_util_frac",
+            "pipelining_opportunity_s", "ok", "error",
+        )
+    }
+    speedup = (
+        round(p["value"] / f["value"], 3)
+        if p.get("value") and f.get("value") else None
+    )
+    result = {
+        "metric": f"service sched A/B ({k_jobs} mixed jobs, fifo vs "
+                  f"pipeline, interleaved best-of-{repeats})",
+        "unit": "x",
+        "value": speedup,
+        "fifo": pick(f),
+        "pipeline": pick(p),
+        "ok": ok,
+        "platform": "cpu",
+    }
+    _append_history({
+        "metric": result["metric"],
+        "value": None,  # the watched series ride the service_/fleet_ keys
+        "unit": "x",
+        "platform": "cpu",
+        "service_sched_ab": {"fifo": pick(f), "pipeline": pick(p)},
+        "service_sched_speedup": speedup,
+        # The pipeline side feeds the watched series: it is the
+        # configuration the scheduling plane ships with.
+        "service_sched": "pipeline",
+        "service_jobs_per_min": p.get("value"),
+        "service_queue_wait_p95_s": p.get("queue_wait_p95_s"),
+        "service_cache_hit_rate": p.get("cache_hit_rate"),
+        "service_k_jobs": k_jobs,
+        "service_mrcheck": p.get("mrcheck"),
+        **{k: v for k, v in p.items()
+           if k.startswith(("fleet_", "pipelining_"))},
     })
     print(json.dumps(result))
     if not ok:
@@ -2488,6 +2624,9 @@ if __name__ == "__main__":
         os.environ["MR_DISPATCH_SYNC"] = "1"
     _chaos = _take_switch(_argv, "--chaos")
     _service_leg = _take_switch(_argv, "--service-leg")
+    _sched_ab = _take_switch(_argv, "--sched-ab")
+    if _sched_ab:
+        _service_leg = True  # --sched-ab alone implies the service leg
     _sort_leg = _take_switch(_argv, "--sort-leg")
     _sweep = _take_flag(_argv, "--sweep-host-workers")
     _sweep_fold = _take_flag(_argv, "--sweep-fold-shards")
@@ -2509,7 +2648,7 @@ if __name__ == "__main__":
             raise SystemExit(1)
     elif _service_leg:
         try:
-            service_leg()
+            service_sched_ab() if _sched_ab else service_leg()
         except SystemExit:
             raise
         except BaseException as e:  # one JSON line, like the main harness

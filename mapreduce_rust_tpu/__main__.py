@@ -75,6 +75,15 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="worker BASE sleep on the -2/-3 sentinels "
                    "(seconds); the poll backs off exponentially from here "
                    "up to 4x (jittered), resetting on a real grant")
+    p.add_argument("--sched", default="fifo", choices=["fifo", "pipeline"],
+                   help="task-grant scheduling (ISSUE 17): fifo = the "
+                   "reference semantics (global map barrier per job, "
+                   "admission-order job polling); pipeline = grant reduce "
+                   "task r the moment every map task has reported bytes "
+                   "for partition r, and score every grantable (job, "
+                   "phase) pair so one job's map windows fill another's "
+                   "barrier bubbles. Outputs bit-identical across modes; "
+                   "coordinator and workers must agree")
     p.add_argument("--chunk-mb", type=float, default=4.0)
     p.add_argument("--device", default="auto", choices=["auto", "tpu", "cpu"])
     p.add_argument("--profile-dir", default=None,
@@ -184,6 +193,7 @@ def _cfg(args, map_n: int = 1, worker_n: int = 1) -> Config:
         poll_retry_s=getattr(args, "poll_retry", 1.0),
         speculate=getattr(args, "speculate", False),
         speculate_after_frac=getattr(args, "speculate_after_frac", 0.75),
+        sched=getattr(args, "sched", "fifo"),
         # No `or` fallbacks anywhere here: an explicit invalid 0 must hit
         # Config's validation error, never be silently remapped to the
         # default (the --dispatch-fill 0 bug class, PR 11 review).

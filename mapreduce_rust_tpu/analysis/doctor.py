@@ -712,15 +712,22 @@ def service_findings(service: "dict | None") -> list:
         # reads as a 100% bubble. Thresholds are deliberately coarse —
         # these are operator prompts, not SLO breaches.
         bubble = fl.get("bubble_frac") or 0.0
-        if bubble > 0.25:
+        # Quiet on pipelined runs (ISSUE 17): the scheduler already
+        # grants reduce per partition and fills barriers with other
+        # jobs' map windows — the opportunity the advice names is
+        # realized, and residual bubble is queue pressure the
+        # service-saturated/service-queue findings already cover.
+        if bubble > 0.25 and service.get("sched") != "pipeline":
             findings.append({
                 "severity": "warn", "code": "barrier-bubble",
                 "key": "barrier-bubble",
                 "message": (
                     f"{bubble:.0%} of fleet worker-seconds idle while "
                     "reduce work was barrier-blocked or jobs sat queued "
-                    f"({fl.get('bubble_ws', 0):.1f} worker-s) — the "
-                    "pipelining headroom ROADMAP item 1 targets; see "
+                    f"({fl.get('bubble_ws', 0):.1f} worker-s) — rerun "
+                    "the service and its workers with `--sched pipeline` "
+                    "to release reduce per partition and fill barrier "
+                    "bubbles with other jobs' map windows; see "
                     "`fleet <work-root>` for the per-job breakdown"
                 ),
             })

@@ -156,6 +156,14 @@ INVARIANTS: dict[str, tuple[str, str]] = {
         "rows after a terminal (double start = restart re-admission and "
         "done-without-start = cache hit are legal)",
     ),
+    "early-reduce-grant": (
+        "events",
+        "a reduce task granted before the map barrier opens must be "
+        "covered by a live part_ready — every map task reported bytes "
+        "for its partition, net of part_retract (ISSUE 17: the pipelined "
+        "per-partition release can never hand a reducer a partition "
+        "whose inputs are still being written)",
+    ),
 }
 
 
@@ -395,6 +403,22 @@ def check_events(events: list) -> list[Violation]:
     deregistered: dict = {}  # wid -> deregister event
     granted: dict = {}    # (job, phase, tid) -> last grant event
     granted_pt: dict = {} # (phase, tid) -> {job: last grant event}
+    ready: dict = {}      # job -> reduce tids ready (net of part_retract)
+
+    # Pre-pass (ISSUE 17): per job, the log position of the LAST first
+    # map finish. A reduce grant positioned before it provably preceded
+    # the barrier opening — the only schedule that makes that legal is
+    # the per-partition release, so a live part_ready must cover it.
+    # Late/duplicate map reports (ev "late_finish", or a repeated tid)
+    # don't extend the window: the barrier opened at the first reports.
+    evs = list(events or [])
+    last_map_first_finish: dict = {}
+    _seen_map_fin: set = set()
+    for i, e in enumerate(evs):
+        if (e.get("ev") == "finish" and e.get("phase") == "map"
+                and (e.get("job"), e.get("tid")) not in _seen_map_fin):
+            _seen_map_fin.add((e.get("job"), e.get("tid")))
+            last_map_first_finish[e.get("job")] = i
 
     def _cross_job(key, pt) -> "dict | None":
         """The other-job grant a job-mismatched continuation event points
@@ -409,7 +433,7 @@ def check_events(events: list) -> list[Violation]:
                 return g
         return None
 
-    for e in events or []:
+    for i, e in enumerate(evs):
         ev = e.get("ev")
         job = e.get("job")
         pt = (e.get("phase"), e.get("tid"))
@@ -417,7 +441,24 @@ def check_events(events: list) -> list[Violation]:
         label = f"{pt[0]} {pt[1]}" + (f" [job {job}]" if job else "")
         if ev == "speculate":
             spec_armed[key] = e
+        elif ev == "part_ready":
+            if pt[0] == "reduce":
+                ready.setdefault(job, set()).add(pt[1])
+        elif ev == "part_retract":
+            if pt[0] == "reduce":
+                ready.setdefault(job, set()).discard(pt[1])
         elif ev == "grant":
+            if (pt[0] == "reduce"
+                    and i < last_map_first_finish.get(job, -1)
+                    and pt[1] not in ready.get(job, ())):
+                v.append(Violation(
+                    "early-reduce-grant",
+                    f"{label} granted before readiness — map finish "
+                    "reports were still landing and no live part_ready "
+                    "covers the partition (its inputs may still be "
+                    "written)",
+                    [e, evs[last_map_first_finish[job]]],
+                ))
             wid = e.get("wid")
             if wid in deregistered:
                 v.append(Violation(
@@ -1310,6 +1351,33 @@ def mutate_job_lifecycle(workdir: str) -> str:
     return "job-lifecycle"
 
 
+def mutate_early_reduce_grant(workdir: str) -> str:
+    """Clone a reduce grant to BEFORE the first map finish — a reduce
+    task handed out while its partition's map inputs were still being
+    written (no part_ready can cover it at that position, and map finish
+    reports are provably still landing after it). A matching expire
+    follows the ghost so the recording's real grant of the same tid
+    doesn't cross-fire grant-over-live-lease."""
+    path, doc, rep = _report_doc(workdir)
+    events = rep.get("events") or []
+    i, first_map_fin = next(
+        (i, e) for i, e in enumerate(events)
+        if e.get("ev") == "finish" and e.get("phase") == "map"
+    )
+    g = next(e for e in events
+             if e.get("ev") == "grant" and e.get("phase") == "reduce")
+    t = max(first_map_fin.get("t", 0.0) - 0.002, 0.0)
+    ghost = dict(g)
+    ghost["t"] = t
+    exp = {"t": t + 0.001, "ev": "expire", "phase": "reduce",
+           "tid": g.get("tid"), "attempt": g.get("attempt")}
+    if g.get("job") is not None:
+        exp["job"] = g["job"]
+    rep["events"] = events[:i] + [ghost, exp] + events[i:]
+    _dump_json(path, doc)
+    return "early-reduce-grant"
+
+
 #: name -> (needs_trace, mutator). The seeded-violation fixture table:
 #: every entry corrupts a RECORDED run's artifacts so the named invariant
 #: fires with the offending event pair — proving the checker detects it —
@@ -1331,4 +1399,5 @@ MUTATIONS: dict = {
     "write-race": (True, mutate_write_race),
     "grant-across-jobs": (False, mutate_grant_across_jobs),
     "job-lifecycle": (False, mutate_job_lifecycle),
+    "early-reduce-grant": (False, mutate_early_reduce_grant),
 }

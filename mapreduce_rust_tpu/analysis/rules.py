@@ -1691,6 +1691,66 @@ class UnreapedJobLabelsRule(ProgramRule):
             )
 
 
+class FifoPollInSchedulerRule(ProgramRule):
+    """Scheduler grant loops must consult the scoring seam (rule 17).
+
+    ISSUE 17 replaced the service's admission-order job polling with a
+    scored candidate order (``_sched_order``: priority class, phase
+    criticality, worker recent-job affinity). The shipped-bug shape is
+    the old ``JobService.get_task``: a ``for job in <running …>:`` loop
+    inside a scheduler-named scope that calls the per-phase grant RPCs
+    directly — admission order silently decides fleet placement again,
+    reintroducing the barrier bubbles the pipeline scheduler exists to
+    fill, and the regression is invisible (every output stays correct,
+    only ``fleet_bubble_frac`` drifts up). Sanctioned shape: the scope
+    consults the seam — mentions ``_sched_order``/``sched_pipeline`` or
+    a score — anywhere in its body; FIFO-as-oracle then lives INSIDE the
+    seam, not beside it.
+    """
+
+    name = "fifo-poll-in-scheduler"
+    summary = ("scheduler grant loops must consult the scoring seam, "
+               "not admission order")
+
+    _GRANTS = ("get_map_task", "get_reduce_task")
+    _SEAMS = ("_sched_order", "sched_order", "sched_pipeline")
+
+    @staticmethod
+    def _scheduler_scope(fu) -> bool:
+        q = fu.qualname.lower()
+        return "sched" in q or q.rsplit(".", 1)[-1] == "get_task"
+
+    def run_program(self, program):
+        for fu in program.functions:
+            if not self._scheduler_scope(fu):
+                continue
+            if any(_mentions(fu.node, s) for s in self._SEAMS) \
+                    or _mentions(fu.node, "score", substring=True):
+                continue
+            for n in ast.walk(fu.node):
+                if not isinstance(n, (ast.For, ast.AsyncFor)):
+                    continue
+                if not _mentions(n.iter, "running", substring=True):
+                    continue
+                if not any(
+                    isinstance(c, ast.Call)
+                    and isinstance(c.func, ast.Attribute)
+                    and c.func.attr in self._GRANTS
+                    for c in ast.walk(n)
+                ):
+                    continue
+                yield self.finding(
+                    fu.path, n,
+                    f"{fu.qualname} grants tasks in admission order — a "
+                    "`for … in running` poll loop that never consults "
+                    "the scoring seam; iterate _sched_order(wid) "
+                    "(priority, phase criticality, worker affinity) so "
+                    "one job's map windows can fill another's barrier "
+                    "bubbles, with FIFO kept as a mode inside the seam",
+                )
+                break  # one finding per scope names the class of bug
+
+
 ALL_RULES: list[Rule] = [
     StatsOwnershipRule(),
     ExecutorTeardownRule(),
@@ -1717,4 +1777,5 @@ PROGRAM_RULES: list[ProgramRule] = [
     DeviceDispatchInConsumerRule(),
     UnsampledRangePartitionRule(),
     UnreapedJobLabelsRule(),
+    FifoPollInSchedulerRule(),
 ]

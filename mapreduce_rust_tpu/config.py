@@ -295,6 +295,27 @@ class Config:
                                     # 0 = off. Standard scrapers work
                                     # against a long-lived coordinator.
 
+    # ---- Fleet scheduler (ISSUE 17) ----
+    sched: str = "fifo"             # task-grant scheduling mode. "fifo"
+                                    # preserves the reference semantics:
+                                    # a strict global map barrier per job
+                                    # (reduce waits for the WHOLE map
+                                    # phase) and admission-order job
+                                    # polling in the service. "pipeline"
+                                    # grants reduce task r the moment
+                                    # every map task has reported bytes
+                                    # for partition r (per-partition
+                                    # readiness from the part_bytes
+                                    # vectors, retracted when a
+                                    # contributing attempt dies) and the
+                                    # service scores every grantable
+                                    # (job, phase) pair — priority class,
+                                    # phase criticality, worker recent-job
+                                    # affinity — so one job's map windows
+                                    # fill another's barrier bubbles.
+                                    # Outputs are bit-identical across
+                                    # modes; fifo stays the A/B oracle.
+
     # ---- Multi-tenant job service (ISSUE 14) ----
     service_max_jobs: int = 3       # concurrent RUNNING jobs the service
                                     # admits; further submissions queue
@@ -440,6 +461,9 @@ class Config:
             raise ValueError("metrics_port must be >= 0 (0 = off)")
         if self.poll_retry_cap_s is not None and self.poll_retry_cap_s <= 0:
             raise ValueError("poll_retry_cap_s must be positive (or None)")
+        if self.sched not in ("fifo", "pipeline"):
+            raise ValueError(f"unknown sched {self.sched!r} "
+                             "(expected 'fifo' or 'pipeline')")
         if self.service_max_jobs < 1:
             raise ValueError("service_max_jobs must be >= 1")
         if self.service_inflight_budget_mb <= 0:
@@ -479,6 +503,13 @@ class Config:
         if self.input_dirs is not None:
             return tuple(self.input_dirs)
         return (("corpus", self.input_dir),)
+
+    @property
+    def sched_pipeline(self) -> bool:
+        """True when the fleet scheduler pipelines phases (ISSUE 17):
+        per-partition reduce release in the coordinator + scored
+        cross-job granting in the service."""
+        return self.sched == "pipeline"
 
     def effective_poll_retry_cap_s(self) -> float:
         return self.poll_retry_cap_s or 4.0 * self.poll_retry_s

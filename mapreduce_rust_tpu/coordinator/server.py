@@ -129,19 +129,36 @@ class _Phase:
         # and the time-saved estimate both need the older attempt's age)
         self.spec_live: dict[int, int] = {}     # tid → live speculative copies
 
-    def grant(self) -> int:
+    def grant(self, eligible=None) -> int:
         """Next task id per the reference grant path (coordinator.rs:137-176):
         fresh ids first, then a rescan for expired-and-reset tasks, then
-        WAIT while leases are outstanding, DONE once finished."""
+        WAIT while leases are outstanding, DONE once finished.
+
+        ``eligible`` (ISSUE 17): restrict the grant to this id set — the
+        pipelined per-partition reduce release. Grants the lowest
+        unassigned eligible id; NOT_READY while ungranted ids exist but
+        none is eligible yet (readiness-gated, the same sentinel as the
+        classic barrier), WAIT once every id is assigned (stragglers)."""
         if self.finished:
             return DONE
-        if self.next_id < self.n:
-            tid = self.next_id
-            self.next_id += 1
+        if eligible is None:
+            if self.next_id < self.n:
+                tid = self.next_id
+                self.next_id += 1
+            else:
+                tid = next((i for i, a in self.assigned.items() if not a), None)
+                if tid is None:
+                    return WAIT  # all assigned, leases outstanding — stragglers
         else:
-            tid = next((i for i, a in self.assigned.items() if not a), None)
+            tid = next((i for i, a in self.assigned.items()
+                        if not a and i in eligible), None)
             if tid is None:
-                return WAIT  # all assigned, leases outstanding — stragglers
+                return WAIT if all(self.assigned.values()) else NOT_READY
+            # Out-of-order issue: keep the issued counter ahead of every
+            # granted id so report_finish's all-issued finish condition
+            # stays truthful; ids jumped over remain assigned=False and
+            # are served by the rescan path once they become eligible.
+            self.next_id = max(self.next_id, tid + 1)
         self.assigned[tid] = True
         now = time.monotonic()
         self.leases[tid] = now + self.lease_timeout_s
@@ -336,6 +353,22 @@ class Coordinator:
         # over the `stats` RPC and dumped as work_dir/job_report.json at
         # done(). Aggregate counters only (runtime/metrics.py doctrine).
         self.report = JobReport(job_id=job_id)
+        if cfg.sched_pipeline:
+            # Stamp the artifact so offline consumers (fleet profiler,
+            # doctor) know the barrier was dissolved on this run; fifo
+            # runs stay byte-identical to the pre-sched wire format.
+            self.report.sched = cfg.sched
+        # Per-partition readiness (ISSUE 17 tentpole a): which map tids
+        # have covered reduce partition r with a finish-report bytes
+        # vector. ``_parts_ready`` is the pipelined reduce release's grant
+        # filter; maintained in BOTH sched modes so the event log always
+        # carries part_ready/part_retract evidence for mrcheck's
+        # early-reduce-grant replay (fifo grants trivially satisfy it).
+        self._part_cover: dict[int, set[int]] = {
+            r: set() for r in range(cfg.reduce_n)
+        }
+        self._map_cover: dict[int, tuple] = {}  # map tid → covered r's
+        self._parts_ready: set[int] = set()
         self._flow_finished: set[str] = set()  # flow ids already terminated
         self.drained: set[int] = set()  # wids that deregistered gracefully
         # Live speculation records: (phase, tid) → the original/speculative
@@ -487,8 +520,9 @@ class Coordinator:
         base = f"{name}:{tid}:{attempt}"
         return f"{self.job_id}:{base}" if self.job_id else base
 
-    def _grant(self, phase: "_Phase", name: str, wid: int = -1) -> int:
-        tid = phase.grant()
+    def _grant(self, phase: "_Phase", name: str, wid: int = -1,
+               eligible=None) -> int:
+        tid = phase.grant(eligible)
         if tid == WAIT and self.cfg.speculate:
             tid = self._maybe_speculate(phase, name, wid)
         if tid >= 0:
@@ -574,7 +608,16 @@ class Coordinator:
 
     def get_reduce_task(self, wid: int = -1) -> int:
         if not self.map.finished:
-            return NOT_READY  # phase gate (coordinator.rs:183-185)
+            if not self.cfg.sched_pipeline:
+                return NOT_READY  # phase gate (coordinator.rs:183-185)
+            # Per-partition release (ISSUE 17): before the barrier only
+            # partitions every map task has covered with reported bytes
+            # are grantable; the rest answer NOT_READY exactly like the
+            # classic gate. Inputs for a ready partition are final (all
+            # m spill files written via atomic rename), so reduce output
+            # is bit-identical to the barriered schedule.
+            return self._grant(self.reduce, "reduce", wid,
+                               eligible=self._parts_ready)
         return self._grant(self.reduce, "reduce", wid)
 
     # ``sample`` on the renewal RPCs (ISSUE 8): the worker's latest live
@@ -675,6 +718,68 @@ class Coordinator:
             self._journal(name, tid, attempt=attempt, wid=wid)
         return done
 
+    # ---- per-partition readiness (ISSUE 17) ----
+
+    def _record_readiness(self, tid: int, part_bytes, wid: int = -1) -> None:
+        """Fold one map task's FIRST finish report into per-partition
+        coverage. Partition r is ready once every map task has reported a
+        bytes entry for it (zero bytes counts — the shard file exists and
+        is final); becoming ready logs a ``part_ready`` event, the
+        evidence mrcheck's early-reduce-grant replay checks reduce grants
+        against. Same validation posture as record_partition_ready: the
+        vector is remote input, malformed ⇒ drop the whole report
+        (coverage stays conservative — an uncovered partition just keeps
+        its reduce task gated)."""
+        if not isinstance(part_bytes, (list, tuple)) \
+                or len(part_bytes) > JobReport.PARTITIONS_CAP:
+            return
+        if tid in self._map_cover or not (0 <= tid < self.cfg.map_n):
+            return
+        for b in part_bytes:
+            if isinstance(b, bool) or not isinstance(b, (int, float)):
+                return
+        covered = tuple(range(min(len(part_bytes), self.cfg.reduce_n)))
+        self._map_cover[tid] = covered
+        for r in covered:
+            cov = self._part_cover[r]
+            cov.add(tid)
+            if len(cov) >= self.cfg.map_n and r not in self._parts_ready:
+                self._parts_ready.add(r)
+                self.report.record_event("part_ready", "reduce", r, wid=wid)
+
+    def _retract_readiness(self, tid: int) -> None:
+        """A map attempt's lease expired with coverage on the books: the
+        re-executed attempt will rewrite its shard files, so whatever
+        readiness this tid established is no longer grant-worthy. Pull it
+        out of every partition's cover set and close any partition that
+        drops below full coverage, logging ``part_retract`` so the replay
+        re-gates its readiness watermark; the re-report re-establishes
+        coverage through _record_readiness. (Structurally defensive today
+        — a lease only exists for UNreported tids and coverage only comes
+        from first reports, which pop the lease — but the lease/attempt
+        machine is extended under that assumption rather than relying on
+        it, and mrcheck replays the net-of-retractions watermark.)"""
+        covered = self._map_cover.pop(tid, None)
+        if covered is None:
+            return
+        for r in covered:
+            self._part_cover[r].discard(tid)
+            if r in self._parts_ready:
+                self._parts_ready.discard(r)
+                self.report.record_event("part_retract", "reduce", r)
+
+    def reduce_ready_backlog(self) -> int:
+        """READY-but-ungranted reduce partitions — work a pipelined fleet
+        could start this instant. The service's bubble accounting (ISSUE
+        17) counts fleet idle against this instead of against the map
+        barrier window, which pipelining dissolved as a bubble."""
+        if self.reduce.finished:
+            return 0
+        if self.map.finished:
+            return sum(1 for a in self.reduce.assigned.values() if not a)
+        return sum(1 for r in self._parts_ready
+                   if not self.reduce.assigned.get(r, False))
+
     def report_map_task_finish(self, tid: int, attempt: int = 0,
                                wid: int = -1, job=None,
                                part_bytes=None) -> bool:
@@ -687,6 +792,7 @@ class Coordinator:
         # identical shard files; readiness was already achieved).
         if part_bytes is not None and tid not in self.map.reported:
             self.report.record_partition_ready(tid, part_bytes)
+            self._record_readiness(tid, part_bytes, wid=wid)
         done = self._finish(self.map, "map", tid, attempt, wid)
         log.info("map %d finished (phase done=%s)", tid, done)
         return done
@@ -781,15 +887,28 @@ class Coordinator:
         return self.map.finished and self.reduce.finished
 
     def check_lease(self) -> None:
-        phase, name = (self.reduce, "reduce") if self.map.finished else (self.map, "map")
-        for tid in phase.expire_stale():
-            self.report.record_expiry(name, tid)
-            if self._spec.pop((name, tid), None) is not None:
-                # The shared lease ran out: BOTH the original and its
-                # speculative copy went silent — the speculation bought
-                # nothing and the normal expiry path re-grants from scratch.
-                self.report.record_speculation_result(name, won=False)
-            log.warning("%s task %d lease expired — rescheduling", name, tid)
+        # FIFO scans the phase the barrier says is active; pipeline mode
+        # (ISSUE 17) scans BOTH — reduce leases legally exist before the
+        # map barrier, and a dead map attempt must retract the readiness
+        # it established (see _retract_readiness) before the re-grant.
+        if self.cfg.sched_pipeline:
+            pairs = ((self.map, "map"), (self.reduce, "reduce"))
+        else:
+            pairs = ((self.reduce, "reduce") if self.map.finished
+                     else (self.map, "map"),)
+        for phase, name in pairs:
+            for tid in phase.expire_stale():
+                self.report.record_expiry(name, tid)
+                if name == "map":
+                    self._retract_readiness(tid)
+                if self._spec.pop((name, tid), None) is not None:
+                    # The shared lease ran out: BOTH the original and its
+                    # speculative copy went silent — the speculation bought
+                    # nothing and the normal expiry path re-grants from
+                    # scratch.
+                    self.report.record_speculation_result(name, won=False)
+                log.warning("%s task %d lease expired — rescheduling",
+                            name, tid)
 
     # ---- transport ----
 

@@ -313,7 +313,14 @@ def build_fleet_report(target: str) -> dict:
                    and ev.get("phase") == "map"
                    and isinstance(ev.get("t"), (int, float))]
         has_reduce = "reduce" in (rep.get("totals") or {})
-        if len(map_fin) > 1 and has_reduce:
+        if rep.get("sched") == "pipeline":
+            # The scheduler dissolved the barrier (ISSUE 17): reduce
+            # tasks were grantable per partition throughout the map
+            # window, so idle inside it is plain idle, not a structural
+            # bubble — no barrier_window, and the sched stamp rides the
+            # job row so readers can tell why it's absent.
+            j["sched"] = "pipeline"
+        elif len(map_fin) > 1 and has_reduce:
             j["barrier_window"] = (round(base + min(map_fin), 6),
                                    round(base + max(map_fin), 6))
 

@@ -100,6 +100,12 @@ class JobReport:
         # instant the LAST byte-contributing map shard for r landed — the
         # fleet profiler's pipelining-opportunity input.
         self._partitions: dict[int, dict] = {}
+        # Scheduling mode stamp (ISSUE 17): "pipeline" when the producing
+        # coordinator granted reduce tasks per-partition (no global map
+        # barrier). Offline consumers key off this — the fleet profiler
+        # stops counting the barrier window as a bubble, and the doctor's
+        # barrier-bubble advice goes quiet (the opportunity is realized).
+        self.sched: "str | None" = None
         self._t0 = time.monotonic()
 
     def _jdim(self) -> "str | None":
@@ -465,6 +471,8 @@ class JobReport:
             out["workers"] = self.workers_summary()
         if self._partitions:
             out["partitions"] = self.partitions_summary()
+        if self.sched is not None:
+            out["sched"] = self.sched
         return out
 
     def summary(self) -> str:

@@ -883,7 +883,17 @@ class JobService:
         if not bubble:
             for job in self.running.values():
                 c = job.coord
-                if c is not None and not c.map.finished and c.map.reported:
+                if c is None:
+                    continue
+                if self.cfg.sched_pipeline:
+                    # Pipelining dissolved the barrier as a bubble (ISSUE
+                    # 17): idle is a bubble only against READY-but-
+                    # ungranted reduce partitions — work the scheduler
+                    # could have placed this instant but didn't.
+                    if c.reduce_ready_backlog() > 0:
+                        bubble = True
+                        break
+                elif not c.map.finished and c.map.reported:
                     bubble = True
                     break
         if bubble:
@@ -897,11 +907,15 @@ class JobService:
         if ws is None:
             ws = self._worker_state[wid] = {
                 "job": None, "phase": None, "since": 0.0,
-                "busy_s": 0.0, "grants": 0,
+                "busy_s": 0.0, "grants": 0, "last_job": None,
             }
         if ws["job"] is None:
             ws["since"] = self.report.uptime_s()
         ws["job"], ws["phase"] = jid, phase
+        # Affinity signal for the pipeline scheduler (ISSUE 17): survives
+        # release, so a worker between tasks still prefers the job whose
+        # spec/dictionary caches it holds.
+        ws["last_job"] = jid
         ws["grants"] += 1
 
     def _fleet_release(self, wid) -> None:
@@ -1100,32 +1114,69 @@ class JobService:
     def _running_in_order(self) -> list:
         return list(self.running.values())  # dict preserves admission order
 
+    def _sched_order(self, wid) -> list:
+        """The scoring seam (ISSUE 17): the ordered (job, phase)
+        candidates get_task tries. FIFO mode reproduces the reference
+        semantics exactly — one phase per running job (map until the
+        barrier opens, then reduce), admission order, so a WAITing map
+        phase also gates that job's reduce. Pipeline mode scores every
+        grantable (job, phase) pair instead: priority class first, then
+        phase criticality — ready reduce partitions (the job's exit path)
+        beat a near-done map wave beat a fresh one — then the worker's
+        recent-job affinity (its spec/dictionary caches are warm), with
+        admission order as the deterministic tiebreak. Job B's map
+        windows fill job A's barrier bubbles; what each phase may grant
+        is still the per-job coordinator's call (per-partition release
+        included), so outputs stay bit-identical across modes."""
+        jobs = [j for j in self._running_in_order()
+                if j.coord is not None and j.state == "running"]
+        if not self.cfg.sched_pipeline:
+            return [(j, "map" if not j.coord.map.finished else "reduce")
+                    for j in jobs]
+        last_job = None
+        if isinstance(wid, int) and wid >= 0:
+            ws = self._worker_state.get(wid)
+            if ws is not None:
+                last_job = ws.get("last_job")
+        cands = []
+        for seq, j in enumerate(jobs):
+            c = j.coord
+            phases = []
+            if not c.map.finished:
+                phases.append("map")
+                if c.reduce_ready_backlog() > 0:
+                    phases.append("reduce")  # per-partition release
+            elif not c.reduce.finished:
+                phases.append("reduce")
+            for phase in phases:
+                if phase == "reduce":
+                    crit = 3
+                else:
+                    done = len(c.map.reported)
+                    crit = 2 if c.map.n and done * 2 >= c.map.n else 1
+                affinity = 1 if j.jid == last_job else 0
+                cands.append((-j.priority, -crit, -affinity, seq, phase, j))
+        cands.sort(key=lambda t: t[:4])
+        return [(t[5], t[4]) for t in cands]
+
     def get_task(self, wid: int = -1):
-        """The fleet's combined pull: one grant from the first running job
-        (admission order) that has work, tagged with its job id — the
-        service worker's single polling RPC. Returns a dict grant, WAIT
-        (nothing grantable right now), or DONE (drained and empty: the
-        fleet can go home)."""
+        """The fleet's combined pull: one grant from the best-scored
+        (job, phase) candidate that has work (see _sched_order — FIFO
+        mode is verbatim admission-order polling), tagged with its job id
+        — the service worker's single polling RPC. Returns a dict grant,
+        WAIT (nothing grantable right now), or DONE (drained and empty:
+        the fleet can go home)."""
         if self.draining and not self.running:
             return DONE
-        for job in self._running_in_order():
+        for job, phase in self._sched_order(wid):
             c = job.coord
-            if c is None or job.state != "running":
-                continue
-            if not c.map.finished:
-                tid = c.get_map_task(wid)
-                if isinstance(tid, int) and tid >= 0:
-                    job.grants += 1
-                    self._fleet_grant(wid, job.jid, "map")
-                    return {"job": job.jid, "phase": "map", "tid": tid,
-                            "attempt": c.report.attempts("map", tid)}
-                continue  # WAIT/NOT_READY: this job's reduce is gated too
-            tid = c.get_reduce_task(wid)
+            tid = (c.get_map_task(wid) if phase == "map"
+                   else c.get_reduce_task(wid))
             if isinstance(tid, int) and tid >= 0:
                 job.grants += 1
-                self._fleet_grant(wid, job.jid, "reduce")
-                return {"job": job.jid, "phase": "reduce", "tid": tid,
-                        "attempt": c.report.attempts("reduce", tid)}
+                self._fleet_grant(wid, job.jid, phase)
+                return {"job": job.jid, "phase": phase, "tid": tid,
+                        "attempt": c.report.attempts(phase, tid)}
         return WAIT
 
     def job_spec(self, jid=None) -> dict:
@@ -1365,6 +1416,7 @@ class JobService:
 
     def service_summary(self) -> dict:
         return {
+            "sched": self.cfg.sched,
             "uptime_s": round(self.report.uptime_s(), 3),
             "queued": self.queued_count(),
             "running": len(self.running),

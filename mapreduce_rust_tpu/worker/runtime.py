@@ -524,8 +524,13 @@ class Worker:
         """The pull loop proper — between registration and teardown.
         Returns True when the worker exited because a DRAIN was
         requested. The classic two-phase machine here; the ServiceWorker
-        overrides this with the multi-job loop (same setup/teardown)."""
+        overrides this with the multi-job loop (same setup/teardown).
+        Under ``--sched pipeline`` (ISSUE 17) the two phases interleave
+        instead — coordinator and workers must agree on the mode."""
         wid = self.worker_id
+        if self.cfg.sched_pipeline:
+            log.info("worker %d: pipelined map+reduce loop", wid)
+            return await self._run_pipelined(client)
         log.info("worker %d: map phase", wid)
         draining = await self._run_phase(
             client, "get_map_task", "renew_map_lease",
@@ -536,6 +541,58 @@ class Worker:
                 client, "get_reduce_task", "renew_reduce_lease",
                 "report_reduce_task_finish", self.run_reduce_task)
         return draining
+
+    async def _run_pipelined(self, client: CoordinatorClient) -> bool:
+        """Interleaved pull loop (``--sched pipeline``, ISSUE 17): one
+        poll round asks the map side first and, when it has nothing to
+        give right now (WAIT — every map issued, stragglers in flight),
+        asks for per-partition-released reduce work, so this worker
+        starts reducing ready partitions while other workers' map tasks
+        are still running. DONE from the reduce side ends the job; DONE
+        from the map side just stops asking it. Same drain/backoff/
+        teardown contract as _run_phase."""
+        poll = Backoff(
+            base_s=self.cfg.poll_retry_s,
+            cap_s=self.cfg.effective_poll_retry_cap_s(),
+            jitter=0.25,
+        )
+        map_done = False
+        while True:
+            if self._drain.is_set():
+                return True  # between tasks: nothing held, nothing owed
+            try:
+                if not map_done:
+                    tid = await self._call_with_retry(
+                        client, "get_map_task", self._wid)
+                    if tid == DONE:
+                        map_done = True
+                    elif tid not in (NOT_READY, WAIT):
+                        poll.reset()
+                        att = client.last_attempt or 1
+                        if not await self._execute_granted(
+                                client, "map", tid, att, "renew_map_lease",
+                                "report_map_task_finish", self.run_map_task):
+                            return False
+                        continue  # map side is hot — ask it again first
+                tid = await self._call_with_retry(
+                    client, "get_reduce_task", self._wid)
+            except ConnectionError:
+                log.info("coordinator gone — assuming job complete")
+                return False
+            if tid == DONE:
+                return False
+            if tid not in (NOT_READY, WAIT):
+                poll.reset()
+                att = client.last_attempt or 1
+                if not await self._execute_granted(
+                        client, "reduce", tid, att, "renew_reduce_lease",
+                        "report_reduce_task_finish", self.run_reduce_task):
+                    return False
+                continue
+            maybe_snapshot()
+            self._metrics_tick()
+            self._sample_memory()
+            await asyncio.sleep(poll.next_delay())
 
     def _execute_task(self, run_task, tid: int) -> None:
         """Executor-thread task wrapper: per-task data-plane accounting +

@@ -241,6 +241,36 @@ def test_chaos_smoke_pause_plus_sigkill(tmp_path):
         assert doc["ok"], (leg["scenario"], doc["violations"])
 
 
+def test_chaos_pipeline_kill_bit_identical(tmp_path):
+    """ISSUE 17 satellite: the seeded kill:map SIGKILL under --sched
+    pipeline, real OS processes. Per-partition reduce release must
+    survive the mid-map re-execution (readiness retracted on expiry,
+    re-established by the rerun) and stay BIT-IDENTICAL to the
+    fault-free FIFO run of the same binaries — the A/B oracle across
+    both the scheduler and the fault. mrcheck (early-reduce-grant
+    included) replays both legs."""
+    clean = bench._chaos_cluster("clean", tmp_path, None, False)
+    assert clean["recovered"]
+    pipe = bench._chaos_cluster(
+        "kill-pipe", tmp_path, "seed=2;kill:map:1", False, sched="pipeline"
+    )
+    assert pipe["recovered"]
+    assert pipe["outputs"] == clean["outputs"]
+    assert read_outputs(pathlib.Path(pipe["dir"]) / "out") == _chaos_oracle()
+    rep = json.loads(
+        (pathlib.Path(pipe["dir"]) / "work" / "job_report.json").read_text()
+    )["report"]
+    # The artifact is stamped for offline consumers (fleet, doctor), and
+    # the SIGKILL left the expiry + re-execution mark recovery took.
+    assert rep.get("sched") == "pipeline"
+    assert sum(t.get("expiries", 0) for t in rep["totals"].values()) >= 1
+    from mapreduce_rust_tpu.analysis.mrcheck import run_check
+
+    for leg in (clean, pipe):
+        doc = run_check(str(pathlib.Path(leg["dir"]) / "work"))
+        assert doc["ok"], (leg["scenario"], doc["violations"])
+
+
 # ---------------------------------------------------------------------------
 # Tier-1: speculation effectiveness + revocation (the acceptance race)
 # ---------------------------------------------------------------------------

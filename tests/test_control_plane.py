@@ -147,6 +147,101 @@ def test_job_report_counts_expiry_and_reexecution(tmp_path):
     assert t["completed"] and t["duration_s"] >= 0
 
 
+
+# ---- pipelined scheduler (ISSUE 17): per-partition reduce release ----
+
+def test_pipeline_reduce_gated_on_partition_readiness(tmp_path):
+    """--sched pipeline: before the barrier, reduce polls are gated on
+    per-partition readiness (NOT_READY, same sentinel as the classic
+    gate) — a partition is grantable only once EVERY map task reported
+    bytes for it, and becoming ready logs the part_ready evidence
+    mrcheck's early-reduce-grant invariant replays."""
+    cfg = make_cfg(tmp_path, 2, worker_n=1, sched="pipeline")
+    c = Coordinator(cfg)
+    c.get_worker_id()
+    assert c.get_map_task() == 0
+    assert c.get_map_task() == 1
+    # Nothing reported: no partition can be ready.
+    assert c.get_reduce_task() == NOT_READY
+    assert c.reduce_ready_backlog() == 0
+    # First map reports bytes for all three partitions — coverage is
+    # still partial (map 1 outstanding), so nothing is released.
+    c.report_map_task_finish(0, part_bytes=[1, 2, 3])
+    assert c.get_reduce_task() == NOT_READY
+    assert c.reduce_ready_backlog() == 0
+    assert not any(e["ev"] == "part_ready" for e in c.report.events())
+    # Second map reports: every partition reaches full coverage, the
+    # backlog surfaces (the service scheduler's scoring input) and the
+    # grant path serves readiness-eligible ids.
+    c.report_map_task_finish(1, part_bytes=[1, 2, 3])
+    assert c.reduce_ready_backlog() == cfg.reduce_n
+    ready_evs = [e for e in c.report.events() if e["ev"] == "part_ready"]
+    assert sorted(e["tid"] for e in ready_evs) == list(range(cfg.reduce_n))
+    assert c.get_reduce_task() == 0
+    assert c.reduce_ready_backlog() == cfg.reduce_n - 1
+
+
+def test_pipeline_readiness_retract_and_reestablish(tmp_path):
+    """The retraction path (ISSUE 17): when a map attempt's coverage is
+    withdrawn (the expiry → re-execution protocol), every partition it
+    pushed to full coverage drops out of the grantable set with a
+    part_retract event, and the re-executed report re-establishes it.
+    Driven directly — with tid-keyed leases a reported map can never
+    expire, so the path is structurally defensive today, but the replay
+    evidence contract (retract net of re-establish) is load-bearing for
+    mrcheck and must hold."""
+    cfg = make_cfg(tmp_path, 2, worker_n=1, reduce_n=2, sched="pipeline")
+    c = Coordinator(cfg)
+    c._record_readiness(0, [1, 1])
+    c._record_readiness(1, [1, 1])
+    assert c._parts_ready == {0, 1}
+    c._retract_readiness(0)
+    assert c._parts_ready == set()
+    assert [e["tid"] for e in c.report.events()
+            if e["ev"] == "part_retract"] == [0, 1]
+    # Re-execution reports again: full coverage re-established.
+    c._record_readiness(0, [1, 1])
+    assert c._parts_ready == {0, 1}
+    # Malformed remote input is dropped whole, never partially folded.
+    c._retract_readiness(1)
+    c._record_readiness(1, [1, "nan"])
+    assert c._parts_ready == set()
+
+
+def test_cluster_pipeline_bit_identical_to_fifo(tmp_path):
+    """End-to-end A/B oracle (ISSUE 17 acceptance, in-process edition):
+    the same corpus through --sched fifo and --sched pipeline produces
+    BIT-IDENTICAL output files, the pipelined report carries the sched
+    stamp offline consumers key on, and both runs replay clean under
+    mrcheck (early-reduce-grant included)."""
+    write_corpus(tmp_path)
+    outs, coords, cfgs = {}, {}, {}
+    for sched in ("fifo", "pipeline"):
+        cfg = make_cfg(
+            tmp_path, len(TEXTS), worker_n=2, sched=sched,
+            work_dir=str(tmp_path / sched / "work"),
+            output_dir=str(tmp_path / sched / "out"),
+        )
+        coord, _ws = asyncio.run(_run_cluster(cfg, 2))
+        outs[sched] = {
+            p.name: p.read_bytes()
+            for p in sorted(pathlib.Path(cfg.output_dir).glob("mr-*.txt"))
+        }
+        coords[sched], cfgs[sched] = coord, cfg
+    assert outs["pipeline"] == outs["fifo"]
+    assert read_outputs(cfgs["pipeline"]) == oracle()
+    rep = coords["pipeline"].report
+    assert rep.sched == "pipeline"
+    assert rep.to_dict().get("sched") == "pipeline"
+    # FIFO artifacts stay byte-identical to the pre-sched wire format.
+    assert "sched" not in coords["fifo"].report.to_dict()
+    from mapreduce_rust_tpu.analysis.mrcheck import run_check
+
+    for sched, cfg in cfgs.items():
+        doc = run_check(cfg.work_dir)
+        assert doc["ok"], (sched, doc["violations"])
+
+
 def test_stats_rpc_over_socket(tmp_path):
     # The 8th RPC rides the same JSON transport as the sentinels and
     # reflects the live scheduler state, including server-side RPC latency.

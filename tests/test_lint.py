@@ -1513,6 +1513,72 @@ def test_unreaped_job_labels_silent_when_reap_is_reachable(tmp_path):
     assert fired == []
 
 
+def test_fifo_poll_in_scheduler_fires_on_admission_order_loop(tmp_path):
+    # The shipped-bug shape: the pre-ISSUE-17 JobService.get_task — poll
+    # running jobs in admission order, grant from the first with work.
+    fired, report = program_rules_fired(tmp_path, """
+        class JobService:
+            def get_task(self, wid):
+                for job in self.running.values():
+                    c = job.coord
+                    if not c.map.finished:
+                        tid = c.get_map_task(wid)
+                        if tid >= 0:
+                            return {"job": job.jid, "tid": tid}
+                        continue
+                    tid = c.get_reduce_task(wid)
+                    if tid >= 0:
+                        return {"job": job.jid, "tid": tid}
+                return -3
+    """)
+    assert fired == ["fifo-poll-in-scheduler"]
+    msg = report.findings[0].message
+    assert "get_task" in msg and "_sched_order" in msg
+
+
+def test_fifo_poll_in_scheduler_silent_through_scoring_seam(tmp_path):
+    # The shipped-fix shape: the grant loop iterates the scoring seam;
+    # FIFO survives as a MODE inside it (admission order is the
+    # tiebreak), which is exactly where the rule wants it.
+    fired, _ = program_rules_fired(tmp_path, """
+        class JobService:
+            def _sched_order(self, wid):
+                jobs = list(self.running.values())
+                if not self.cfg.sched_pipeline:
+                    return [(j, "map") for j in jobs]
+                return sorted(
+                    ((j, p) for j in jobs for p in ("map", "reduce")),
+                    key=lambda t: -t[0].priority,
+                )
+
+            def get_task(self, wid):
+                for job, phase in self._sched_order(wid):
+                    tid = job.coord.get_map_task(wid)
+                    if tid >= 0:
+                        return {"job": job.jid, "tid": tid}
+                return -3
+    """)
+    assert fired == []
+
+
+def test_fifo_poll_in_scheduler_ignores_non_scheduler_scopes(tmp_path):
+    # A bubble-accounting sweep over running jobs is not a grant loop,
+    # and a grant loop outside a scheduler-named scope is some other
+    # harness's business — both stay silent.
+    fired, _ = program_rules_fired(tmp_path, """
+        class JobService:
+            def fleet_tick(self):
+                for job in self.running.values():
+                    if job.coord.map.reported:
+                        self.bubble += 1
+
+        def drain_harness(coord, running):
+            for job in running:
+                coord.get_map_task(0)
+    """)
+    assert fired == []
+
+
 def test_unreaped_job_labels_ignores_unlabeled_and_free_functions(tmp_path):
     # Unlabeled writes carry no cardinality hazard; free functions have
     # no teardown seam to anchor a reap to — both stay silent.

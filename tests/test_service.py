@@ -710,6 +710,109 @@ def test_get_task_interleaves_jobs_and_drains(tmp_path):
     assert svc.get_task(0) == DONE
 
 
+# ---------------------------------------------------------------------------
+# Fleet-wide scheduler (ISSUE 17): scoring seam + fifo/pipeline A/B
+# ---------------------------------------------------------------------------
+
+def test_sched_order_fifo_is_admission_order_single_phase(tmp_path):
+    # FIFO mode reproduces the reference polling exactly: one candidate
+    # per running job, admission order, map until the barrier opens.
+    docs = write_corpus(tmp_path / "in", TEXTS_A)
+    svc = JobService(make_cfg(tmp_path, service_max_jobs=2))
+    svc.get_worker_id()
+    a = svc.submit_job({"app": "word_count", "input_dir": docs})["job"]
+    b = svc.submit_job({"app": "word_count", "input_dir": docs,
+                        "reduce_n": 2})["job"]
+    order = [(j.jid, ph) for j, ph in svc._sched_order(0)]
+    assert order == [(a, "map"), (b, "map")]
+    # Open job A's barrier: its candidate flips to reduce, the order is
+    # still admission order — a WAITing phase up front gates the rest.
+    ca = svc.jobs[a].coord
+    for t in range(ca.cfg.map_n):
+        ca.get_map_task(0)
+        ca.report_map_task_finish(t, wid=0,
+                                  part_bytes=[1] * ca.cfg.reduce_n)
+    order = [(j.jid, ph) for j, ph in svc._sched_order(0)]
+    assert order == [(a, "reduce"), (b, "map")]
+
+
+def test_sched_order_pipeline_scores_candidates(tmp_path):
+    """Pipeline mode scores every grantable (job, phase): priority class
+    first, then phase criticality (ready reduce > near-done map wave >
+    fresh wave), then worker recent-job affinity, admission order as the
+    deterministic tiebreak."""
+    docs = write_corpus(tmp_path / "in", TEXTS_A)
+    svc = JobService(make_cfg(tmp_path, service_max_jobs=3,
+                              sched="pipeline"))
+    svc.get_worker_id()
+    a = svc.submit_job({"app": "word_count", "input_dir": docs})["job"]
+    b = svc.submit_job({"app": "word_count", "input_dir": docs,
+                        "reduce_n": 2})["job"]
+    order = [(j.jid, ph) for j, ph in svc._sched_order(0)]
+    assert order == [(a, "map"), (b, "map")]  # equal score: admission
+    # Affinity: a worker that last pulled from job B prefers B at equal
+    # priority/criticality (its caches are warm).
+    svc._worker_state.setdefault(0, {})["last_job"] = b
+    order = [(j.jid, ph) for j, ph in svc._sched_order(0)]
+    assert order[0] == (b, "map")
+    # Criticality: push job B's map wave past half done — it outscores
+    # a fresh wave for EVERY worker, affinity or not.
+    cb = svc.jobs[b].coord
+    half = (cb.cfg.map_n + 1) // 2
+    for t in range(half):
+        cb.get_map_task(0)
+        cb.report_map_task_finish(t, wid=0,
+                                  part_bytes=[1] * cb.cfg.reduce_n)
+    order = [(j.jid, ph) for j, ph in svc._sched_order(1)]
+    assert order[0] == (b, "map")
+    # Barrier open on B: its ready reduce partitions are the job's exit
+    # path — criticality 3, ahead of every map candidate.
+    for t in range(half, cb.cfg.map_n):
+        cb.get_map_task(0)
+        cb.report_map_task_finish(t, wid=0,
+                                  part_bytes=[1] * cb.cfg.reduce_n)
+    assert cb.map.finished
+    order = [(j.jid, ph) for j, ph in svc._sched_order(1)]
+    assert order[0] == (b, "reduce")
+    # Priority class dominates everything below it.
+    c = svc.submit_job({"app": "word_count", "input_dir": docs,
+                        "reduce_n": 5}, 5)["job"]
+    order = [(j.jid, ph) for j, ph in svc._sched_order(1)]
+    assert order[0] == (c, "map")
+
+
+def test_service_pipeline_bit_identical_to_fifo(tmp_path):
+    """ISSUE 17 acceptance (in-process edition): the same two-job mix
+    through the service under --sched fifo and --sched pipeline yields
+    BIT-IDENTICAL per-job outputs, and both work roots replay clean
+    under mrcheck (early-reduce-grant included). The scheduler reorders
+    who pulls what when; what a task computes must never move."""
+    docs = write_corpus(tmp_path / "in", TEXTS_A)
+    specs = [
+        {"app": "word_count", "input_dir": docs, "reduce_n": 3},
+        {"app": "inverted_index", "input_dir": docs, "reduce_n": 2},
+    ]
+    outs: dict = {}
+    for sched in ("fifo", "pipeline"):
+        cfg = make_cfg(
+            tmp_path, service_max_jobs=2, sched=sched,
+            work_dir=str(tmp_path / sched / "work"),
+            output_dir=str(tmp_path / sched / "out"),
+        )
+        svc, results = asyncio.run(_drive_service(cfg, specs))
+        assert svc.service_summary()["sched"] == sched
+        outs[sched] = {
+            r["job"]: output_bytes(
+                pathlib.Path(cfg.output_dir) / f"job-{r['job']}"
+            )
+            for r in results
+        }
+        doc = run_check(cfg.work_dir)
+        assert doc["ok"], (sched, doc["violations"])
+    # Same spec → same deterministic jid → keys align across modes.
+    assert outs["pipeline"] == outs["fifo"]
+
+
 def test_classic_single_job_worker_stays_wire_valid(tmp_path):
     """Old single-job RPCs stay wire-valid against the service: a
     pre-service Worker (no job tags anywhere) completes the only running
