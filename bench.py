@@ -522,6 +522,48 @@ def sort_leg_main() -> None:
         raise SystemExit(1)
 
 
+def model_leg() -> None:
+    """``bench.py --model-leg``: mrmodel exploration throughput (ISSUE
+    18) — the lease and pipeline foci at a fixed budget/depth/seed, in
+    process (the model checker is jax-free by contract). Appends one
+    history row carrying model_schedules_per_s (trend-watched, bad =
+    down: the exploration loop slowing down shrinks the schedule space a
+    fixed CI budget actually covers) plus explored/pruned so a pruning
+    regression (same budget, fewer pruned) is visible in the trajectory.
+    Prints ONE JSON line; exit 1 when a focus finds a counterexample —
+    a bench leg must never silently bless a broken control plane."""
+    from mapreduce_rust_tpu.analysis.mrmodel import run_model
+
+    budget = int(os.environ.get("BENCH_MODEL_BUDGET", "1500"))
+    depth = int(os.environ.get("BENCH_MODEL_DEPTH", "12"))
+    docs = {f: run_model(focus=f, budget=budget, depth=depth, seed=0)
+            for f in ("lease", "pipeline")}
+    explored = sum(d["explored"] for d in docs.values())
+    elapsed = sum(d["elapsed_s"] for d in docs.values())
+    ok = all(d["ok"] for d in docs.values())
+    result: dict = {
+        "metric": f"mrmodel exploration, lease+pipeline foci at "
+                  f"budget {budget} depth {depth}",
+        "unit": "schedules/s",
+        "value": None,  # the GB/s trend series must never mix in these
+        "platform": "cpu",
+        "model_schedules_per_s": (round(explored / elapsed, 1)
+                                  if elapsed > 0 else None),
+        "model_explored": explored,
+        "model_pruned": sum(d["pruned"] for d in docs.values()),
+        "model_steps": sum(d["steps"] for d in docs.values()),
+        "model_ok": ok,
+        "model_counterexamples": [
+            {"focus": f, "code": c["code"], "chaos_spec": c["chaos_spec"]}
+            for f, d in docs.items() for c in d["counterexamples"]
+        ],
+    }
+    _append_history(result)
+    print(json.dumps(result))
+    if not ok:
+        raise SystemExit(1)
+
+
 def micro_leg() -> None:
     """Runs in a subprocess (--micro): device micro-benchmarks that survive
     even when the end-to-end leg falls back — map-step ms/MB, h2d MB/s,
@@ -2460,7 +2502,7 @@ def _append_history(result: dict) -> None:
         line.update({
             k: v for k, v in result.items()
             if k.startswith(("chaos_", "service_", "sort_", "fleet_",
-                             "pipelining_"))
+                             "pipelining_", "model_"))
         })
         if result.get("chaos_scenario"):
             line["doctor_findings"] = [
@@ -2628,6 +2670,7 @@ if __name__ == "__main__":
     if _sched_ab:
         _service_leg = True  # --sched-ab alone implies the service leg
     _sort_leg = _take_switch(_argv, "--sort-leg")
+    _model_leg = _take_switch(_argv, "--model-leg")
     _sweep = _take_flag(_argv, "--sweep-host-workers")
     _sweep_fold = _take_flag(_argv, "--sweep-fold-shards")
     _sweep_spill = _take_flag(_argv, "--sweep-spill-budget")
@@ -2644,6 +2687,18 @@ if __name__ == "__main__":
                 "metric": "global sort over Zipf corpus",
                 "unit": "s", "value": None,
                 "error": f"sort-leg harness: {e!r}",
+            }))
+            raise SystemExit(1)
+    elif _model_leg:
+        try:
+            model_leg()
+        except SystemExit:
+            raise
+        except BaseException as e:  # one JSON line, like the main harness
+            print(json.dumps({
+                "metric": "mrmodel exploration, lease+pipeline foci",
+                "unit": "schedules/s", "value": None,
+                "error": f"model-leg harness: {e!r}",
             }))
             raise SystemExit(1)
     elif _service_leg:

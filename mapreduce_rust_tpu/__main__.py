@@ -729,6 +729,16 @@ def cmd_check(args) -> int:
     return run_cli(args)
 
 
+def cmd_model(args) -> int:
+    """mrmodel (ISSUE 18): exhaustive bounded exploration of control-plane
+    schedules — the REAL Coordinator/JobService under a virtual clock —
+    with DPOR pruning, fault injection at every step, and counterexample
+    shrinking to a chaos-grammar repro. Backend-free like check/lint."""
+    from mapreduce_rust_tpu.analysis.mrmodel import run_cli
+
+    return run_cli(args)
+
+
 def cmd_fleet(args) -> int:
     """Fleet profiler (ISSUE 16): cross-job utilization timeline,
     barrier-bubble accounting, pipelining opportunity. Backend-free like
@@ -1015,6 +1025,37 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("-v", "--verbose", action="store_true",
                    help="text format: print every timeline interval")
 
+    p = sub.add_parser(
+        "model",
+        help="mrmodel: exhaustive bounded control-plane schedule "
+        "exploration (real coordinator/service logic under a virtual "
+        "clock), DPOR-pruned, with counterexample shrinking and "
+        "chaos-grammar repro export",
+    )
+    p.add_argument("--budget", type=int, default=5000,
+                   help="maximum complete schedules to explore "
+                   "(default 5000)")
+    p.add_argument("--depth", type=int, default=12,
+                   help="maximum events per schedule (default 12)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="rotation seed: which subtrees a truncated budget "
+                   "reaches first (the explored SET under an exhaustive "
+                   "budget is seed-independent)")
+    p.add_argument("--focus", choices=["pipeline", "lease", "service"],
+                   default="lease",
+                   help="which control-plane surface to explore: lease = "
+                   "fifo + speculation + expiry races, pipeline = "
+                   "per-partition readiness, service = multi-job "
+                   "queue/cancel lifecycle (default lease)")
+    p.add_argument("--mutate", default=None, metavar="CLASS",
+                   help="mutation-teeth mode: arm this mrcheck.MUTATIONS "
+                   "class as a seeded fault event and search for a "
+                   "schedule whose corrupted artifacts the invariant "
+                   "catalog flags (exit 1 + shrunk counterexample = the "
+                   "checker has teeth)")
+    p.add_argument("--format", choices=["text", "json"], default="text",
+                   help="json: the full model document for CI diffs")
+
     p = sub.add_parser("stats", help="pretty-print a run manifest, or diff two")
     p.add_argument("manifest", help="manifest.json of a run")
     p.add_argument("other", nargs="?", default=None,
@@ -1156,6 +1197,7 @@ def main(argv: list[str] | None = None) -> int:
         "watch": cmd_watch,
         "lint": cmd_lint,
         "check": cmd_check,
+        "model": cmd_model,
         "fleet": cmd_fleet,
     }[args.cmd](args)
 

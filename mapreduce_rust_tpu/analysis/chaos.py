@@ -261,3 +261,21 @@ class ChaosPlan:
         """Every fault that actually triggered, in order — the manifest's
         honest record of what this run was subjected to."""
         return list(self.events)
+
+
+def build_spec(seed: int, faults: "list[str]") -> str:
+    """Render fault elements into one canonical seeded spec — the export
+    side of the grammar (mrmodel's counterexample → chaos repro). The
+    result is round-tripped through :meth:`ChaosPlan.parse` before it is
+    returned: a malformed export is a bug in the exporter, and it fails
+    HERE, not in the worker that later replays the repro."""
+    elems: list[str] = []
+    for f in faults:
+        f = f.strip()
+        if f and f not in elems:
+            elems.append(f)
+    if not elems:
+        raise ValueError("chaos: build_spec needs at least one fault")
+    spec = ";".join([f"seed={int(seed)}"] + elems)
+    ChaosPlan.parse(spec)
+    return spec

@@ -942,6 +942,12 @@ TREND_SERIES: dict[str, str] = {
     "fleet_bubble_frac": "up",
     "fleet_util_frac": "down",
     "pipelining_opportunity_s": "up",
+    # Model checker (ISSUE 18): mrmodel exploration throughput over the
+    # fixed bench budget. Drifting DOWN means the real control plane (or
+    # the invariant replay it runs per schedule) got slower — and since
+    # CI explores under a fixed time box, a slower loop silently shrinks
+    # the schedule space actually covered.
+    "model_schedules_per_s": "down",
 }
 
 

@@ -1006,6 +1006,35 @@ def run_check_service(target: str, job_dirs: list,
     }
 
 
+def check_stream(events: list, journal: "list | None" = None,
+                 report: "dict | None" = None,
+                 events_dropped: "int | None" = None) -> list[Violation]:
+    """Schedule-callable invariant pass over IN-MEMORY artifacts (ISSUE
+    18): the ordered event rows, optionally the parsed journal lines and
+    the report dict — no files, no tempfile round-trips, so mrmodel can
+    validate every explored prefix per-step. run_check's authoritative
+    file-backed pass routes through here; ``events_dropped`` defaults to
+    the report's own counter."""
+    violations = check_events(events or [])
+    violations += check_journal(journal, report)
+    if events_dropped is None:
+        events_dropped = (report or {}).get("events_dropped") or 0
+    if events_dropped:
+        # The cap's contract is "counted, never silent" — and mrcheck
+        # is the counter's one consumer. A truncated log means any
+        # event-backed violation AFTER the cap is invisible, so an
+        # exit-0 here would be the oracle silently not running.
+        violations.append(Violation(
+            "truncated-event-log",
+            f"the event log dropped {events_dropped} row(s) at its cap — "
+            "the event-backed invariants were replayed against an "
+            "incomplete log (a violation past the cap is invisible)",
+            [{"ev": "events_dropped", "count": events_dropped},
+             events[-1] if events else {"ev": "empty-log"}],
+        ))
+    return violations
+
+
 def run_check(target: str, trace: "str | None" = None,
               journal: "str | None" = None,
               job_report: "str | None" = None) -> dict:
@@ -1024,21 +1053,8 @@ def run_check(target: str, trace: "str | None" = None,
     events = report.get("events") or []
     dropped = report.get("events_dropped") or 0
     if art["authoritative"]:
-        violations += check_events(events)
-        violations += check_journal(art["journal"], report)
-        if dropped:
-            # The cap's contract is "counted, never silent" — and mrcheck
-            # is the counter's one consumer. A truncated log means any
-            # event-backed violation AFTER the cap is invisible, so an
-            # exit-0 here would be the oracle silently not running.
-            violations.append(Violation(
-                "truncated-event-log",
-                f"the event log dropped {dropped} row(s) at its cap — the "
-                "event-backed invariants were replayed against an "
-                "incomplete log (a violation past the cap is invisible)",
-                [{"ev": "events_dropped", "count": dropped},
-                 events[-1] if events else {"ev": "empty-log"}],
-            ))
+        violations += check_stream(events, art["journal"], report,
+                                   events_dropped=dropped)
     else:
         # Worker-side target: its local event log is not the protocol
         # authority (see load_artifacts) — replaying it would call a

@@ -923,6 +923,90 @@ class MetricInHotLoopRule(Rule):
 
 # ---------------------------------------------------------------------------
 # Interprocedural program rules (the ISSUE 7 dataflow layer)
+class NakedClockInControlPlaneRule(Rule):
+    """No direct ``time.monotonic()`` / ``time.time()`` calls inside the
+    control-plane state machines.
+
+    Incident: mrmodel (ISSUE 18) explores the real Coordinator/JobService
+    under a virtual clock — the whole point is that no model rewrite can
+    drift from the shipped logic. That only holds while every wall-clock
+    read in those classes routes through the injectable ``self._now``
+    seam: one naked ``time.monotonic()`` and model time and real time
+    disagree mid-schedule, so lease expiry explores a state the cluster
+    can never reach (or misses one it can). The seam ASSIGNMENT
+    (``self._now = now if now is not None else time.monotonic``) is a
+    function reference, not a call, and stays legal; ``time.perf_counter``
+    latency stamps are measurement, not scheduling, and are out of scope.
+    """
+
+    name = "naked-clock-in-control-plane"
+    summary = ("control-plane classes read the clock via the _now seam, "
+               "never time.monotonic()/time.time() directly")
+
+    #: The classes mrmodel drives under a virtual clock — plus any class
+    #: that publishes an RPC ``_METHODS`` table (a control-plane surface
+    #: by construction, whatever it is named).
+    _CONTROL_CLASSES = frozenset({
+        "Coordinator", "JobService", "_Phase", "JobReport",
+        "Worker", "ServiceWorker",
+    })
+    _CLOCKS = frozenset({"monotonic", "time"})
+
+    def _from_imports(self, tree) -> dict[str, str]:
+        out: dict[str, str] = {}
+        for n in ast.walk(tree):
+            if isinstance(n, ast.ImportFrom) and n.module:
+                for alias in n.names:
+                    out[alias.asname or alias.name] = n.module
+        return out
+
+    @staticmethod
+    def _defines_methods_table(cls: ast.ClassDef) -> bool:
+        for stmt in cls.body:
+            if isinstance(stmt, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "_METHODS"
+                    for t in stmt.targets):
+                return True
+        return False
+
+    def _is_naked_clock(self, call: ast.Call, from_imports) -> "str | None":
+        q = qualname(call.func)
+        if not q:
+            return None
+        last = _last_segment(q)
+        if last not in self._CLOCKS:
+            return None
+        if q == f"time.{last}" or q.endswith(f".time.{last}"):
+            return f"time.{last}"
+        if q == last and from_imports.get(last) == "time":
+            return f"time.{last}"
+        return None
+
+    def run(self, tree, src, path):
+        from_imports = self._from_imports(tree)
+        for cls in ast.walk(tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            if cls.name not in self._CONTROL_CLASSES \
+                    and not self._defines_methods_table(cls):
+                continue
+            for call in ast.walk(cls):
+                if not isinstance(call, ast.Call):
+                    continue
+                clock = self._is_naked_clock(call, from_imports)
+                if clock is None:
+                    continue
+                yield self.finding(
+                    path, call,
+                    f"{clock}() called directly inside control-plane "
+                    f"class {cls.name} — route the read through the "
+                    "injectable clock seam (self._now()) so mrmodel's "
+                    "virtual-clock exploration drives the same code the "
+                    "cluster runs; keep a bare time.monotonic only as "
+                    "the seam's default REFERENCE, never a call",
+                )
+
+
 # ---------------------------------------------------------------------------
 
 
@@ -1751,6 +1835,83 @@ class FifoPollInSchedulerRule(ProgramRule):
                 break  # one finding per scope names the class of bug
 
 
+class RpcArgCompatRule(ProgramRule):
+    """Every parameter of an RPC handler beyond its first operand must be
+    trailing-with-default.
+
+    Incident class: the coordinator/service wire protocol is positional
+    JSON-RPC frames from workers of MIXED vintages — a rolling fleet
+    restart always has old workers calling new servers. The shipped
+    handlers grew ``wid=-1``, ``sample=None``, ``job=None`` one at a time
+    precisely so an old caller's shorter frame still binds; ONE required
+    parameter added mid-signature and every pre-upgrade worker's
+    ``renew_map_lease(tid, wid)`` dies server-side as a TypeError that
+    telemetry records as a stale renewal storm. The RPC surface is
+    whatever the class's own ``_METHODS`` table exports — the rule reads
+    that table, so a new handler is covered the moment it is wired.
+    """
+
+    name = "rpc-arg-compat"
+    summary = ("RPC handler params beyond the first must be "
+               "trailing-with-default (mixed-vintage wire compat)")
+
+    @staticmethod
+    def _methods_literal(cls: ast.ClassDef) -> "set[str] | None":
+        for stmt in cls.body:
+            if isinstance(stmt, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "_METHODS"
+                    for t in stmt.targets):
+                names = {
+                    n.value for n in ast.walk(stmt.value)
+                    if isinstance(n, ast.Constant)
+                    and isinstance(n.value, str)
+                }
+                return names or None
+        return None
+
+    def run_program(self, program):
+        for path, tree in program.files:
+            for cls in ast.walk(tree):
+                if not isinstance(cls, ast.ClassDef):
+                    continue
+                methods = self._methods_literal(cls)
+                if not methods:
+                    continue
+                for fn in cls.body:
+                    if not isinstance(fn, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef)):
+                        continue
+                    if fn.name not in methods:
+                        continue
+                    yield from self._check_signature(path, cls, fn)
+
+    def _check_signature(self, path, cls, fn):
+        a = fn.args
+        pos = list(a.posonlyargs) + list(a.args)
+        if pos and pos[0].arg in ("self", "cls"):
+            pos = pos[1:]
+        required = len(pos) - len(a.defaults)
+        for i, arg in enumerate(pos):
+            if 1 <= i < required:
+                yield self.finding(
+                    path, arg,
+                    f"RPC handler {cls.name}.{fn.name} parameter "
+                    f"{arg.arg!r} is required — a positional wire frame "
+                    "from a pre-upgrade worker omits it and the call "
+                    "dies as a server-side TypeError; new RPC params "
+                    "must be trailing-with-default",
+                )
+        for arg, default in zip(a.kwonlyargs, a.kw_defaults):
+            if default is None:
+                yield self.finding(
+                    path, arg,
+                    f"RPC handler {cls.name}.{fn.name} keyword-only "
+                    f"parameter {arg.arg!r} has no default — positional "
+                    "wire frames can never supply it, so every caller "
+                    "of any vintage fails; give it a default",
+                )
+
+
 ALL_RULES: list[Rule] = [
     StatsOwnershipRule(),
     ExecutorTeardownRule(),
@@ -1763,6 +1924,7 @@ ALL_RULES: list[Rule] = [
     PsumReplicatedFlagRule(),
     UnboundedRetryRule(),
     MetricInHotLoopRule(),
+    NakedClockInControlPlaneRule(),
 ]
 
 #: Interprocedural rules: run once per lint over the whole file set, on
@@ -1778,4 +1940,5 @@ PROGRAM_RULES: list[ProgramRule] = [
     UnsampledRangePartitionRule(),
     UnreapedJobLabelsRule(),
     FifoPollInSchedulerRule(),
+    RpcArgCompatRule(),
 ]

@@ -115,7 +115,11 @@ class ClockSync:
 class _Phase:
     """Task table of one phase: assignment flags, fresh-id counter, leases."""
 
-    def __init__(self, n: int, lease_timeout_s: float) -> None:
+    def __init__(self, n: int, lease_timeout_s: float, now=None) -> None:
+        # Injectable clock seam (ISSUE 18): lease arithmetic reads
+        # ``self._now`` so mrmodel drives the real table under a virtual
+        # clock. ``now=None`` keeps the monotonic default unchanged.
+        self._now = now if now is not None else time.monotonic
         self.n = n
         self.assigned: dict[int, bool] = {i: False for i in range(n)}
         self.next_id = 0
@@ -160,7 +164,7 @@ class _Phase:
             # are served by the rescan path once they become eligible.
             self.next_id = max(self.next_id, tid + 1)
         self.assigned[tid] = True
-        now = time.monotonic()
+        now = self._now()
         self.leases[tid] = now + self.lease_timeout_s
         self.last_activity[tid] = now
         self.grant_time[tid] = now
@@ -171,7 +175,7 @@ class _Phase:
         race the reference asserts on (coordinator.rs:125,132)."""
         if tid not in self.leases:
             return False
-        now = time.monotonic()
+        now = self._now()
         self.leases[tid] = now + self.lease_timeout_s
         self.last_activity[tid] = now
         return True
@@ -193,7 +197,7 @@ class _Phase:
         return self.finished
 
     def expire_stale(self) -> list[int]:
-        now = time.monotonic()
+        now = self._now()
         dead = [tid for tid, deadline in self.leases.items() if deadline <= now]
         for tid in dead:
             del self.leases[tid]
@@ -334,8 +338,13 @@ class Coordinator:
     """
 
     def __init__(self, cfg: Config, resume: bool = True,
-                 job_id: "str | None" = None) -> None:
+                 job_id: "str | None" = None, now=None) -> None:
         self.cfg = cfg
+        # Injectable clock seam (ISSUE 18): ONE trailing hook threaded to
+        # both phase tables and the report, so mrmodel explores the real
+        # grant/finish/expiry logic under a virtual clock. Default keeps
+        # ``time.monotonic`` — real runs are bit-identical.
+        self._now = now if now is not None else time.monotonic
         # Multi-tenant job service (ISSUE 14): when this scheduler is one
         # job of a JobService, ``job_id`` namespaces everything that would
         # otherwise collide across co-hosted jobs — journal lines carry a
@@ -345,14 +354,15 @@ class Coordinator:
         # jobs' attempts into one). None = the classic single-job
         # coordinator, wire- and artifact-identical to before.
         self.job_id = job_id
-        self.map = _Phase(cfg.map_n, cfg.lease_timeout_s)
-        self.reduce = _Phase(cfg.reduce_n, cfg.lease_timeout_s)
+        self.map = _Phase(cfg.map_n, cfg.lease_timeout_s, now=self._now)
+        self.reduce = _Phase(cfg.reduce_n, cfg.lease_timeout_s,
+                             now=self._now)
         self.worker_count = 0
         # Control-plane telemetry: grants, renewals, expiries, re-executions
         # and task durations per (phase, tid), plus RPC latencies — served
         # over the `stats` RPC and dumped as work_dir/job_report.json at
         # done(). Aggregate counters only (runtime/metrics.py doctrine).
-        self.report = JobReport(job_id=job_id)
+        self.report = JobReport(job_id=job_id, now=self._now)
         if cfg.sched_pipeline:
             # Stamp the artifact so offline consumers (fleet profiler,
             # doctor) know the barrier was dissolved on this run; fifo
@@ -430,7 +440,14 @@ class Coordinator:
                 except OSError:
                     pass
             return
-        for line in lines[1:]:
+        self._replay_journal_lines(lines[1:])
+
+    def _replay_journal_lines(self, lines) -> None:
+        """Seed phase tables from journal BODY lines (header already
+        validated/stripped). Split out of _replay_journal so mrmodel's
+        replay-convergence invariant can rebuild a coordinator from any
+        in-memory journal prefix without a file round-trip."""
+        for line in lines:
             try:
                 # Two fields is the original record; later fields (attempt,
                 # wid, wall-clock — `map 3 a2 w1 t12.345`) are mrcheck
@@ -554,7 +571,7 @@ class Coordinator:
         # in-flight task is eligible (the fleet is idle — duplication is
         # the cheap side of the trade, per Coded TeraSort).
         p50 = self.report.phase_task_p50(name, min_count=3)
-        now = time.monotonic()
+        now = self._now()
         best_tid, best_age = None, -1.0
         for tid in phase.leases:
             holder = self._tasks_wid(name, tid)
@@ -679,12 +696,12 @@ class Coordinator:
         # report_finish pops it — the time-saved estimate is against the
         # lease-expiry-only recovery the reference has (the loser's lease
         # would still have had to run out before a re-grant even started).
-        lease_remaining = max(phase.leases.get(tid, 0.0) - time.monotonic(), 0.0)
+        lease_remaining = max(phase.leases.get(tid, 0.0) - self._now(), 0.0)
         done = phase.report_finish(tid)
         if first:
             spec = self._spec.pop((name, tid), None)
             if spec is not None:
-                now = time.monotonic()
+                now = self._now()
                 # The reporter's own attempt number decides the race. An
                 # attempt-less report (0: pre-attempt client / default
                 # caller) is UNATTRIBUTABLE — falling back to attempts()
@@ -828,7 +845,7 @@ class Coordinator:
         liveness from renewal recency: a lease with no grant/renewal inside
         ~3 renew periods belongs to a worker that is wedged or dead — the
         thing `watch` exists to show while the lease detector counts down."""
-        now = time.monotonic()
+        now = self._now()
         live_window = max(3 * self.cfg.lease_renew_period_s, 1.5)
         phases: dict = {}
         for name, ph in (("map", self.map), ("reduce", self.reduce)):
@@ -1054,16 +1071,16 @@ class Coordinator:
         log.info("coordinator on %s:%d (map_n=%d reduce_n=%d worker_n=%d)",
                  self.cfg.host, self.cfg.port, self.cfg.map_n, self.cfg.reduce_n, self.cfg.worker_n)
         try:
-            last_check = time.monotonic()
+            last_check = self._now()
             while not self.done():
                 await asyncio.sleep(min(1.0, self.cfg.lease_check_period_s))
-                if time.monotonic() - last_check >= self.cfg.lease_check_period_s:
+                if self._now() - last_check >= self.cfg.lease_check_period_s:
                     self.check_lease()
                     # Streaming doctor at the detector's cadence: the
                     # straggler/lease/skew catalog over the live report,
                     # findings surfaced mid-run (ISSUE 8).
                     self._doctor_tick()
-                    last_check = time.monotonic()
+                    last_check = self._now()
                 # Registry republish + ring sample + scrape-text publish
                 # from the existing poll loop — never the RPC hot path.
                 self._metrics_tick(http_srv)

@@ -55,7 +55,12 @@ class JobReport:
     #: overflow is counted, never silent.
     EVENT_CAP = 20000
 
-    def __init__(self, job_id: "str | None" = None) -> None:
+    def __init__(self, job_id: "str | None" = None, now=None) -> None:
+        # Injectable clock seam (ISSUE 18): every wall-clock read in this
+        # report goes through ``self._now`` so mrmodel can drive the real
+        # control plane under a virtual clock. ``now=None`` keeps the
+        # monotonic default — real runs are bit-identical.
+        self._now = now if now is not None else time.monotonic
         # Multi-tenant job service (ISSUE 14): a per-job report carries
         # its job id on every event-log row, so a combined/multi-job
         # artifact stays per-job replayable (mrcheck keys its machines by
@@ -106,7 +111,7 @@ class JobReport:
         # stops counting the barrier window as a bubble, and the doctor's
         # barrier-bubble advice goes quiet (the opportunity is realized).
         self.sched: "str | None" = None
-        self._t0 = time.monotonic()
+        self._t0 = self._now()
 
     def _jdim(self) -> "str | None":
         """Job dimension of the per-task aggregation: only a MULTI-job
@@ -161,7 +166,7 @@ class JobReport:
         if len(self._events) >= self.EVENT_CAP:
             self._events_dropped += 1
             return
-        row: dict = {"t": round(time.monotonic() - self._t0, 6), "ev": ev}
+        row: dict = {"t": round(self._now() - self._t0, 6), "ev": ev}
         if self.row_job is not None:
             row["job"] = self.row_job
         if phase is not None:
@@ -250,7 +255,7 @@ class JobReport:
         )
 
     def uptime_s(self) -> float:
-        return time.monotonic() - self._t0
+        return self._now() - self._t0
 
     def record_grant(self, phase: str, tid: int, wid=None,
                      attempt=None) -> None:
@@ -260,7 +265,7 @@ class JobReport:
         # is this worker's first grant of the tid).
         t = self._task(phase, tid)
         t["grants"] += 1
-        now = time.monotonic() - self._t0
+        now = self._now() - self._t0
         if t["first_grant_s"] is None:
             t["first_grant_s"] = now
         t["last_grant_s"] = now
@@ -311,7 +316,7 @@ class JobReport:
             return
         t["reports"] += 1
         if t["done_s"] is None:
-            now = time.monotonic() - self._t0
+            now = self._now() - self._t0
             t["done_s"] = now
             # Attempt duration: this grant → this (first) finish. Under a
             # re-execution the last grant belongs to the attempt that is
@@ -342,7 +347,7 @@ class JobReport:
         if not isinstance(part_bytes, (list, tuple)) \
                 or len(part_bytes) > self.PARTITIONS_CAP:
             return
-        now = round(time.monotonic() - self._t0, 6)
+        now = round(self._now() - self._t0, 6)
         for r, b in enumerate(part_bytes):
             if isinstance(b, bool) or not isinstance(b, (int, float)):
                 return  # malformed vector: drop whole report, half a

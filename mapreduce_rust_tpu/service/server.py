@@ -440,9 +440,15 @@ class JobService:
     #: a continuously-traded service would otherwise walk into.
     DONE_JOBS_MAX = 256
 
-    def __init__(self, cfg: Config, resume: bool = True) -> None:
+    def __init__(self, cfg: Config, resume: bool = True,
+                 now=None) -> None:
         self.cfg = cfg
-        self.report = JobReport()  # service-level RPC latencies + uptime
+        # Injectable clock seam (ISSUE 18): one trailing hook, threaded to
+        # the service report and every per-job Coordinator it admits, so
+        # mrmodel explores the real admit/cancel/finalize logic under a
+        # virtual clock. Default keeps ``time.monotonic`` unchanged.
+        self._now = now if now is not None else time.monotonic
+        self.report = JobReport(now=self._now)  # service-level RPC latencies + uptime
         self.jobs: dict[str, Job] = {}
         self.running: dict[str, Job] = {}   # insertion = admission order
         self._queue: list = []              # heap of (-priority, seq, jid)
@@ -1032,7 +1038,8 @@ class JobService:
             job.cfg = self._job_cfg(job)
             # resume=True: a re-queued in-flight job replays its per-job
             # coordinator journal and serves only the gaps.
-            job.coord = Coordinator(job.cfg, resume=True, job_id=job.jid)
+            job.coord = Coordinator(job.cfg, resume=True, job_id=job.jid,
+                                    now=self._now)
         except (ValueError, OSError) as e:
             job.state = "failed"
             job.error = str(e)
@@ -1679,16 +1686,16 @@ class JobService:
             self.cfg.service_inflight_budget_mb, self.cfg.service_cache_entries,
         )
         try:
-            last_check = time.monotonic()
+            last_check = self._now()
             while not (self.draining and not self.running):
                 await asyncio.sleep(min(0.2, self.cfg.lease_check_period_s))
-                if time.monotonic() - last_check \
+                if self._now() - last_check \
                         >= self.cfg.lease_check_period_s:
                     for job in list(self.running.values()):
                         if job.coord is not None:
                             job.coord.check_lease()
                     self._doctor_tick()
-                    last_check = time.monotonic()
+                    last_check = self._now()
                 # Completion scan: a job whose last finish report raced a
                 # connection drop still closes here, and map-only apps'
                 # phase flips are picked up between reports.

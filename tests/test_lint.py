@@ -1592,3 +1592,117 @@ def test_unreaped_job_labels_ignores_unlabeled_and_free_functions(tmp_path):
                 registry.gauge("worker.busy").set(1.0)
     """)
     assert fired == []
+
+
+# ---------------------------------------------------------------------------
+# naked-clock-in-control-plane (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+def test_naked_clock_fires_in_control_plane_class(tmp_path):
+    fired = rules_fired(tmp_path, """
+        import time
+
+        class Coordinator:
+            def progress(self):
+                return time.monotonic() - self.t0
+    """)
+    assert fired == ["naked-clock-in-control-plane"]
+
+
+def test_naked_clock_fires_on_from_import_and_methods_table(tmp_path):
+    # A from-imported bare name, inside a class the rule only knows by
+    # its _METHODS table (a control-plane surface by construction).
+    findings, _ = run_lint(tmp_path, """
+        from time import monotonic
+
+        class FrontDesk:
+            _METHODS = frozenset({"get_task"})
+
+            def get_task(self, wid=-1):
+                self.last_seen[wid] = monotonic()
+                return -3
+    """)
+    assert [f.rule for f in findings] == ["naked-clock-in-control-plane"]
+    assert "time.monotonic" in findings[0].message
+
+
+def test_naked_clock_silent_on_seam_reference_and_perf_counter(tmp_path):
+    # The seam's DEFAULT is a bare function reference (not a call), reads
+    # route through self._now(), and perf_counter latency stamps are
+    # measurement, not scheduling — all legal.
+    assert rules_fired(tmp_path, """
+        import time
+
+        class Coordinator:
+            def __init__(self, cfg, now=None):
+                self._now = now if now is not None else time.monotonic
+
+            def progress(self):
+                t0 = time.perf_counter()
+                now = self._now()
+                return now, time.perf_counter() - t0
+    """) == []
+
+
+def test_naked_clock_silent_outside_control_plane(tmp_path):
+    # Same calls in a data-plane class or a free function: out of scope.
+    assert rules_fired(tmp_path, """
+        import time
+
+        class SpillWriter:
+            def tick(self):
+                return time.time()
+
+        def stamp():
+            return time.monotonic()
+    """) == []
+
+
+# ---------------------------------------------------------------------------
+# rpc-arg-compat (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+def test_rpc_arg_compat_fires_on_required_midsignature_param(tmp_path):
+    fired, report = program_rules_fired(tmp_path, """
+        class Coordinator:
+            _METHODS = frozenset({"renew_map_lease"})
+
+            def renew_map_lease(self, tid, wid):
+                return tid in self.leases and self.holder[tid] == wid
+    """)
+    assert fired == ["rpc-arg-compat"]
+    assert "wid" in report.findings[0].message
+    assert "renew_map_lease" in report.findings[0].message
+
+
+def test_rpc_arg_compat_fires_on_required_kwonly_param(tmp_path):
+    fired, report = program_rules_fired(tmp_path, """
+        class JobService:
+            _METHODS = frozenset({"submit_job"})
+
+            def submit_job(self, spec=None, *, priority):
+                return {"ok": True, "priority": priority}
+    """)
+    assert fired == ["rpc-arg-compat"]
+    assert "priority" in report.findings[0].message
+
+
+def test_rpc_arg_compat_silent_on_trailing_defaults_and_helpers(tmp_path):
+    # The shipped handler shape (one required operand, everything after
+    # it defaulted) is legal; methods OUTSIDE the _METHODS table are not
+    # wire surface and take whatever signature they like.
+    fired, _ = program_rules_fired(tmp_path, """
+        class Coordinator:
+            _METHODS = frozenset({"report_map_task_finish", "stats"})
+
+            def report_map_task_finish(self, tid, attempt=0, wid=-1,
+                                       part_bytes=None):
+                return True
+
+            def stats(self):
+                return {}
+
+            def _finish(self, phase, tid, attempt, wid):
+                return (phase, tid, attempt, wid)
+    """)
+    assert fired == []
