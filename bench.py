@@ -285,6 +285,25 @@ def zipf_leg(target_mb: int) -> None:
     exact = bool(np.array_equal(got, truth))
     from mapreduce_rust_tpu.runtime.spill import RUN_FORMAT
 
+    # Roofline attribution (ISSUE 19): achieved scan bandwidth (bytes
+    # over aggregate scan-thread seconds) vs the calibrated machine roof
+    # (.bench/machine.json — measured once, reused every round). Both
+    # series land top-level in history; the doctor trend watches both
+    # (bad=down): efficiency eroding toward "slow scan" shows here even
+    # when wall seconds drift with corpus size.
+    scan_achieved_gbs = roofline_frac = None
+    try:
+        from mapreduce_rust_tpu.analysis.roofline import calibrate
+
+        machine = calibrate()
+        if s.host_map_s:
+            scan_achieved_gbs = round(s.bytes_in / s.host_map_s / 1e9, 4)
+            roof = machine.get("host_memcpy_gbs")
+            if roof:
+                roofline_frac = round(scan_achieved_gbs / roof, 4)
+    except Exception:
+        pass  # attribution is best-effort; the leg's gates stay exactness
+
     print(json.dumps({
         "zipf": {
             "bytes": s.bytes_in, "wall_s": round(dt, 3),
@@ -314,6 +333,9 @@ def zipf_leg(target_mb: int) -> None:
             "dispatch_stall_s": round(s.dispatch_stall_s, 3),
             "merge_dispatches": s.merge_dispatches,
             "merge_fill_frac": round(s.merge_fill_frac, 4),
+            # Roofline attribution (ISSUE 19) — see calibrate() above.
+            "scan_achieved_gbs": scan_achieved_gbs,
+            "roofline_frac": roofline_frac,
         }
     }))
     if not exact:
@@ -745,6 +767,100 @@ def metrics_overhead_leg(path: str) -> None:
     cpu_frac = (cpu_on - cpu_off) / cpu_off if cpu_off > 0 else None
     print(json.dumps({
         "metrics_overhead": {
+            "platform": platform,
+            "bytes": pathlib.Path(path).stat().st_size,
+            "runs_per_side": repeats,
+            "on_s": round(on_s, 4),
+            "off_s": round(off_s, 4),
+            "frac": round(frac, 5) if frac is not None else None,
+            "cpu_frac": round(cpu_frac, 5) if cpu_frac is not None else None,
+            "outputs_identical": identical,
+        }
+    }))
+
+
+def profile_overhead_leg(path: str) -> None:
+    """Runs in a subprocess (--profile-overhead): the sampler-tax pair
+    for the ISSUE 19 profiler — the metrics_overhead_leg estimator
+    verbatim (min-of-N, interleaved sides, bit-identical outputs gate),
+    with ``Config.profile`` as the toggled knob. Metrics stay at their
+    default on BOTH sides so the measured delta is the profiler alone:
+    one thread waking at 97 Hz to walk sys._current_frames(). The
+    acceptance bar is ≤ 2% wall; `doctor trend` watches the
+    profile_overhead_frac history series (bad direction: up)."""
+    import jax
+
+    platform = jax.devices()[0].platform
+    print(f"BENCH_DEVICE_READY {platform}", file=sys.stderr, flush=True)
+
+    import dataclasses
+
+    from mapreduce_rust_tpu.config import Config
+    from mapreduce_rust_tpu.runtime.driver import (
+        enable_compilation_cache,
+        run_job,
+    )
+
+    enable_compilation_cache("auto")
+    out_root = BENCH_DIR / "profile-overhead"
+    base = Config(
+        map_engine="host",
+        host_map_workers=_env_host_workers(),
+        fold_shards=_env_fold_shards(),
+        host_window_bytes=16 << 20,
+        chunk_bytes=1 << 20,
+        merge_capacity=1 << 17,
+        reduce_n=4,
+        output_dir=str(out_root / "out"),
+        device="auto",
+    )
+
+    warm = BENCH_DIR / "warmup-overhead.txt"
+    with open(path, "rb") as f:
+        warm.write_bytes(f.read(base.host_window_bytes + 4096))
+    run_job(dataclasses.replace(base, profile=False),
+            [str(warm)], write_outputs=False)
+
+    def one(enabled: bool) -> tuple[float, float, dict]:
+        side = "on" if enabled else "off"
+        cfg = dataclasses.replace(
+            base, profile=enabled,
+            output_dir=str(out_root / f"out-{side}"),
+        )
+        c0 = time.process_time()
+        t0 = time.perf_counter()
+        run_job(cfg, [str(path)])
+        wall = time.perf_counter() - t0
+        cpu = time.process_time() - c0
+        outputs = {
+            p.name: p.read_bytes()
+            for p in sorted(pathlib.Path(cfg.output_dir).glob("mr-*.txt"))
+        }
+        return wall, cpu, outputs
+
+    repeats = 15
+    walls: dict = {"on": [], "off": []}
+    cpus: dict = {"on": [], "off": []}
+    outputs: dict = {}
+    identical = True
+    for i in range(repeats):
+        for enabled in ((True, False) if i % 2 == 0 else (False, True)):
+            wall, cpu, out = one(enabled)
+            side = "on" if enabled else "off"
+            walls[side].append(wall)
+            cpus[side].append(cpu)
+            if not out:
+                identical = False
+            elif not outputs:
+                outputs = out
+            elif out != outputs:
+                identical = False
+    on_s, off_s = min(walls["on"]), min(walls["off"])
+    frac = (on_s - off_s) / off_s if off_s > 0 else None
+    cpu_on, cpu_off = min(cpus["on"]), min(cpus["off"])
+    cpu_frac = (cpu_on - cpu_off) / cpu_off if cpu_off > 0 else None
+    print(json.dumps({
+        "profile_overhead": {
             "platform": platform,
             "bytes": pathlib.Path(path).stat().st_size,
             "runs_per_side": repeats,
@@ -2373,6 +2489,28 @@ def main() -> None:
             if overhead is None:
                 errors.append(f"metrics-overhead: {oerr}")
 
+    # Profiler-tax pair (ISSUE 19): same estimator, Config.profile as the
+    # toggled knob. Reuses the metrics-overhead corpus size; the series
+    # doctor `trend` watches is profile_overhead_frac (bad: up), with the
+    # acceptance bar at 2% wall.
+    prof_overhead, perr = None, None
+    if overhead_mb > 0 and os.environ.get("BENCH_PROFILE_OVERHEAD", "1") != "0":
+        try:
+            prof_corpus = build_corpus(min(TARGET_MB, overhead_mb))
+        except Exception as e:
+            errors.append(f"profile-overhead corpus: {e!r}")
+            prof_corpus = None
+        if prof_corpus is not None:
+            prof_overhead, perr = _run_device_leg(
+                prof_corpus,
+                int(os.environ.get("BENCH_METRICS_OVERHEAD_TIMEOUT_S", "300")),
+                _cpu_env(), init_timeout_s=PROBE_TIMEOUT_S,
+                mode="--profile-overhead",
+            )
+            note_probe("profile-overhead", prof_overhead, perr)
+            if prof_overhead is None:
+                errors.append(f"profile-overhead: {perr}")
+
     value = round(dev["gbs"], 4) if dev else None
     platform = dev["info"].get("platform", "unknown") if dev else "none"
     # The corpus label comes from the bytes the measured leg actually
@@ -2405,6 +2543,8 @@ def main() -> None:
         result["zipf"] = zipf.get("zipf")
     if overhead is not None:
         result["metrics_overhead"] = overhead.get("metrics_overhead")
+    if prof_overhead is not None:
+        result["profile_overhead"] = prof_overhead.get("profile_overhead")
     if errors:
         result["error"] = "; ".join(errors)
     result["doctor"] = _doctor_measured_leg(dev)
@@ -2491,6 +2631,17 @@ def _append_history(result: dict) -> None:
             # direction: up) — None on chaos/sweep rows keeps it clean.
             "metrics_overhead_frac": (
                 (result.get("metrics_overhead") or {}).get("frac")
+            ),
+            # Roofline trajectory (ISSUE 19): what the zipf scan achieved
+            # vs the calibrated host memcpy roof — both trend-watched with
+            # bad direction: down (a shrinking frac means the host map is
+            # drifting away from the bandwidth bound it should sit on).
+            "scan_achieved_gbs": (result.get("zipf") or {}).get("scan_achieved_gbs"),
+            "roofline_frac": (result.get("zipf") or {}).get("roofline_frac"),
+            # Profiler tax (ISSUE 19): same shape as the metrics series,
+            # watched with bad direction: up; acceptance bar is 0.02.
+            "profile_overhead_frac": (
+                (result.get("profile_overhead") or {}).get("frac")
             ),
             "had_errors": bool(result.get("error")),
         }
@@ -2783,6 +2934,8 @@ if __name__ == "__main__":
         micro_leg()
     elif len(sys.argv) > 1 and sys.argv[1] == "--metrics-overhead":
         metrics_overhead_leg(sys.argv[2])
+    elif len(sys.argv) > 1 and sys.argv[1] == "--profile-overhead":
+        profile_overhead_leg(sys.argv[2])
     elif len(sys.argv) > 1 and sys.argv[1] == "--zipf":
         zipf_leg(int(sys.argv[2]))
     elif len(sys.argv) > 1 and sys.argv[1] == "--zipf-ii":

@@ -100,6 +100,18 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="disable the live metrics registry/time-series "
                    "ring (runtime/metrics.py); on by default — sampled "
                    "from existing loops, never per record")
+    p.add_argument("--profile", action="store_true",
+                   help="in-process sampling profiler (runtime/prof.py): "
+                   "one thread walks sys._current_frames() at ~97 Hz, "
+                   "collapsed stacks keyed by plane-thread names, into "
+                   "the manifest as stats.profile + a .folded export "
+                   "beside it; inspect with the `prof` subcommand. Off "
+                   "by default (≤2%% tax; MR_PROFILE=1 for a process "
+                   "tree)")
+    p.add_argument("--profile-hz", type=float, default=97.0,
+                   dest="profile_hz", metavar="HZ",
+                   help="sampler rate (default 97 — prime, never "
+                   "phase-locks with periodic work)")
     p.add_argument("--metrics-period", type=float, default=1.0,
                    dest="metrics_period", metavar="SECONDS",
                    help="wall-clock bucket width of the live time-series "
@@ -210,6 +222,8 @@ def _cfg(args, map_n: int = 1, worker_n: int = 1) -> Config:
             args.cache_entries
             if getattr(args, "cache_entries", None) is not None else 64
         ),
+        profile=getattr(args, "profile", False),
+        profile_hz=getattr(args, "profile_hz", 97.0) or 97.0,
         metrics_enabled=not getattr(args, "no_metrics", False),
         metrics_sample_period_s=getattr(args, "metrics_period", 1.0) or 1.0,
         metrics_ring_points=getattr(args, "metrics_ring", 512) or 512,
@@ -739,6 +753,17 @@ def cmd_model(args) -> int:
     return run_cli(args)
 
 
+def cmd_prof(args) -> int:
+    """mrprof (ISSUE 19): render a manifest's sampling profile (per-plane
+    self-time split, top frames), export its collapsed stacks as a
+    .folded file, and attach roofline attribution (achieved-vs-roof per
+    stage from the .bench/machine.json calibration). Backend-free like
+    check/lint/doctor."""
+    from mapreduce_rust_tpu.analysis.roofline import run_cli
+
+    return run_cli(args)
+
+
 def cmd_fleet(args) -> int:
     """Fleet profiler (ISSUE 16): cross-job utilization timeline,
     barrier-bubble accounting, pipelining opportunity. Backend-free like
@@ -1056,6 +1081,30 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--format", choices=["text", "json"], default="text",
                    help="json: the full model document for CI diffs")
 
+    p = sub.add_parser(
+        "prof",
+        help="mrprof: render a run's sampling profile (per-plane "
+        "self-time, top frames), export collapsed stacks for "
+        "flamegraph.pl/speedscope, and attach roofline attribution "
+        "(achieved-vs-roof per stage)",
+    )
+    p.add_argument("manifest",
+                   help="run manifest (stats.profile) or a flight-recorder "
+                   "*.partial.json (its embedded live profile)")
+    p.add_argument("--folded", default=None, metavar="OUT",
+                   help="write the collapsed stacks as a .folded file "
+                   "(flamegraph.pl / speedscope both load it)")
+    p.add_argument("--roofline", action="store_true",
+                   help="attach per-stage achieved-vs-roof attribution; "
+                   "calibrates .bench/machine.json on first use (host "
+                   "memcpy micro-probe; device peaks only when a jax "
+                   "backend is already initialized)")
+    p.add_argument("--machine", default=None, metavar="PATH",
+                   help="calibration file (default .bench/machine.json)")
+    p.add_argument("--format", choices=["text", "json"], default="text",
+                   help="json: the full document for CI diffs")
+    p.add_argument("-v", "--verbose", action="store_true")
+
     p = sub.add_parser("stats", help="pretty-print a run manifest, or diff two")
     p.add_argument("manifest", help="manifest.json of a run")
     p.add_argument("other", nargs="?", default=None,
@@ -1199,6 +1248,7 @@ def main(argv: list[str] | None = None) -> int:
         "check": cmd_check,
         "model": cmd_model,
         "fleet": cmd_fleet,
+        "prof": cmd_prof,
     }[args.cmd](args)
 
 

@@ -319,6 +319,46 @@ def diagnose(manifest: dict, job_report: "dict | None" = None,
                  f"{comp.get('cache_misses', 0)} misses) — warm the "
                  "persistent cache or measure a longer run")
 
+        # ---- roofline attribution (ISSUE 19) ----
+        # "The scan is slow" gets a headroom number: achieved GB/s vs the
+        # calibrated machine roof for the host-map scan. Uses the cached
+        # .bench/machine.json when one exists; otherwise a quick in-memory
+        # memcpy probe (no file written — the doctor is read-only).
+        if stats.get("host_map_split") and stats.get("bytes_in"):
+            try:
+                from mapreduce_rust_tpu.analysis import roofline as _roofline
+
+                machine = _roofline.load_machine() or _roofline.calibrate(
+                    persist=False, size_mb=16)
+                rl = _roofline.roofline_report(manifest, machine)
+            except Exception:
+                rl = None
+            if rl and rl.get("roofline_frac"):
+                diag["roofline"] = rl
+                frac = rl["roofline_frac"]
+                ach = rl["scan_achieved_gbs"]
+                roof = rl["machine"]["host_memcpy_gbs"]
+                proj = rl.get("device_map_projection_x")
+                if frac >= 0.6:
+                    find("warn", "bandwidth-bound",
+                         f"host-map scan runs at {ach:.2f} GB/s = {frac:.0%} "
+                         f"of the {roof:.2f} GB/s host memcpy roof — the "
+                         "host wire is nearly saturated; no same-engine "
+                         "tuning buys much, only the device-resident map "
+                         "(ROADMAP item 2) takes these bytes off the host "
+                         "path"
+                         + (f" (projected ~{proj:g}× at half the device "
+                            "roof)" if proj else ""))
+                else:
+                    find("info", "compute-headroom",
+                         f"host-map scan achieves {ach:.2f} GB/s = {frac:.0%} "
+                         f"of the {roof:.2f} GB/s host memcpy roof — the "
+                         f"scan is compute-limited with ~{1.0 / frac:.1f}× "
+                         "bandwidth headroom on this wire; a device-resident "
+                         "map (ROADMAP item 2) is the lever"
+                         + (f" (projected ~{proj:g}× at half the target "
+                            "roof)" if proj else ""))
+
     # ---- percentiles ----
     hists = {
         name: h.summary(scale=1e3, digits=3)  # seconds → ms
@@ -948,6 +988,18 @@ TREND_SERIES: dict[str, str] = {
     # CI explores under a fixed time box, a slower loop silently shrinks
     # the schedule space actually covered.
     "model_schedules_per_s": "down",
+    # Roofline attribution (ISSUE 19): the zipf leg's host-map scan
+    # achieved GB/s and its fraction of the calibrated memcpy roof.
+    # Either drifting DOWN means the scan is moving AWAY from the
+    # hardware — a native-scan regression or a machine/calibration shift
+    # — exactly the efficiency erosion a wall-seconds series hides when
+    # corpus size drifts with it.
+    "scan_achieved_gbs": "down",
+    "roofline_frac": "down",
+    # Sampler tax (ISSUE 19): the --profile-overhead interleaved pair's
+    # min-of-N estimate; creeping UP is the profiler outgrowing its ≤2%
+    # budget (the metrics_overhead_frac twin).
+    "profile_overhead_frac": "up",
 }
 
 

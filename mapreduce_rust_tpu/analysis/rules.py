@@ -1912,6 +1912,51 @@ class RpcArgCompatRule(ProgramRule):
                 )
 
 
+class UnnamedPlaneThreadRule(Rule):
+    """Plane threads must be named at creation (``name=`` /
+    ``thread_name_prefix=``).
+
+    Incident: ISSUE 19's sampling profiler attributes collapsed stacks
+    by thread name, and the sanitizer's ownership messages print thread
+    names — but the ingest producer and the ingest scan pool rendered as
+    ``Thread-N``/``ThreadPoolExecutor-0_1``, so their samples landed in
+    the unattributable ``other`` plane and ownership reports named
+    nobody. Satellite 1 put every plane thread on the stable ``mr/``
+    scheme; this rule keeps the next thread on it. Scoped to the
+    installed package: test harness threads don't feed profiles.
+    """
+
+    name = "unnamed-plane-thread"
+    summary = "threading.Thread/ThreadPoolExecutor in the package needs " \
+              "name=/thread_name_prefix="
+
+    def run(self, tree, src, path):
+        parts = path.replace("\\", "/").split("/")
+        if "mapreduce_rust_tpu" not in parts:
+            return
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = _last_segment(qualname(node.func))
+            if fn == "Thread" and _kw(node, "name") is None:
+                yield self.finding(
+                    path, node,
+                    "threading.Thread without name= — the profiler "
+                    "attributes samples by thread name and the sanitizer "
+                    "names owners; use the mr/ plane scheme "
+                    "(mr/scan-0, mr/fold-2, mr/spill-acc, mr/dispatch)",
+                )
+            elif (fn == "ThreadPoolExecutor"
+                    and _kw(node, "thread_name_prefix") is None):
+                yield self.finding(
+                    path, node,
+                    "ThreadPoolExecutor without thread_name_prefix= — "
+                    "its workers render as ThreadPoolExecutor-N_M and "
+                    "profile into the unattributable 'other' plane; "
+                    "use the mr/ plane scheme",
+                )
+
+
 ALL_RULES: list[Rule] = [
     StatsOwnershipRule(),
     ExecutorTeardownRule(),
@@ -1925,6 +1970,7 @@ ALL_RULES: list[Rule] = [
     UnboundedRetryRule(),
     MetricInHotLoopRule(),
     NakedClockInControlPlaneRule(),
+    UnnamedPlaneThreadRule(),
 ]
 
 #: Interprocedural rules: run once per lint over the whole file set, on

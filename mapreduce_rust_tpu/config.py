@@ -27,6 +27,16 @@ def sync_dispatch_forced() -> bool:
     )
 
 
+def profile_forced() -> bool:
+    """``MR_PROFILE`` — process-tree opt-in to the sampling profiler
+    (ISSUE 19; the MR_DISPATCH_SYNC enablement pattern): a chaos child
+    or SIGKILL-test subprocess inherits profiling without plumbing a
+    flag through its argv."""
+    return os.environ.get("MR_PROFILE", "").strip().lower() in (
+        "1", "true", "on", "yes"
+    )
+
+
 @dataclasses.dataclass
 class Config:
     # ---- Job shape (reference: argv of mrcoordinator/mrworker) ----
@@ -294,6 +304,20 @@ class Config:
                                     # this port from a dedicated thread;
                                     # 0 = off. Standard scrapers work
                                     # against a long-lived coordinator.
+
+    # ---- Sampling profiler (ISSUE 19) ----
+    profile: bool = False           # in-process sampling profiler
+                                    # (runtime/prof.py): one thread walks
+                                    # sys._current_frames() at profile_hz,
+                                    # collapsed stacks keyed by the mr/
+                                    # plane-thread names, embedded in the
+                                    # manifest as stats.profile and in
+                                    # flight-recorder partials. Off by
+                                    # default (--profile / MR_PROFILE=1);
+                                    # tax gated ≤2% by bench's
+                                    # --profile-overhead pair.
+    profile_hz: float = 97.0        # sampler rate; prime, so it never
+                                    # phase-locks with 1/10/100 Hz work
 
     # ---- Fleet scheduler (ISSUE 17) ----
     sched: str = "fifo"             # task-grant scheduling mode. "fifo"

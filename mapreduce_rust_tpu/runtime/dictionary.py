@@ -234,7 +234,7 @@ class Dictionary:
 
     def _ensure_writer(self) -> "spill_io.AsyncSpillWriter":
         self._writer = spill_io.ensure_writer(
-            self._writer, f"dict-spill-{self._run_token}",
+            self._writer, f"mr/spill-dict-{self._run_token}",
             sync=not self.async_spill,
         )
         return self._writer

@@ -57,7 +57,7 @@ import numpy as np
 
 from mapreduce_rust_tpu.apps.base import App
 from mapreduce_rust_tpu.apps.word_count import WordCount
-from mapreduce_rust_tpu.config import Config, sync_dispatch_forced
+from mapreduce_rust_tpu.config import Config, profile_forced, sync_dispatch_forced
 from mapreduce_rust_tpu.core.kv import KVBatch
 from mapreduce_rust_tpu.ops.groupby import (
     clamp_batch,
@@ -456,7 +456,7 @@ class HostAccumulator:
         from mapreduce_rust_tpu.runtime.spill import ensure_writer
 
         self._writer = ensure_writer(
-            self._writer, f"acc-spill-{self._run_token}",
+            self._writer, f"mr/spill-acc-{self._run_token}",
             sync=not self.async_spill,
         )
         return self._writer
@@ -703,14 +703,16 @@ class _IngestStream:
         # their query keys; the default keep-all mask folds via fast paths.
         self.host_mask = host_mask if host_mask is not None else (lambda keys: None)
         self.workers = max(cfg.ingest_threads, 1)
-        self.pool = ThreadPoolExecutor(max_workers=self.workers)
+        self.pool = ThreadPoolExecutor(max_workers=self.workers,
+                                       thread_name_prefix="mr/ingest-io")
         self.scans: collections.deque = collections.deque()
         self.q: "queue.Queue" = queue.Queue(maxsize=max(cfg.prefetch_chunks, 1))
         self.err: BaseException | None = None
         self._stop = False
         self._doc_ids = list(doc_ids) if doc_ids is not None else None
         self._thread = threading.Thread(
-            target=self._produce, args=(list(inputs), stats, doc_id_offset), daemon=True
+            target=self._produce, args=(list(inputs), stats, doc_id_offset),
+            name="mr/ingest", daemon=True
         )
         self._thread.start()
 
@@ -947,6 +949,39 @@ def make_packed_merge_fn(app: App, cap: int):
     return merge_packed
 
 
+def _merge_cost_analysis(app: App, cfg: Config) -> "dict | None":
+    """``jax.stages`` cost analysis of the jitted packed-merge fn
+    (ISSUE 19): flops + bytes accessed PER DISPATCH — the
+    operational-intensity input the roofline attribution uses for the
+    device-merge stage. Abstract lowering (ShapeDtypeStructs, the shapes
+    the run just used) — no device buffers; the executable cache makes
+    the ``compile()`` a lookup, not a second compile."""
+    cap = cfg.host_update_cap
+    n = cfg.merge_capacity
+    state = KVBatch(
+        k1=jax.ShapeDtypeStruct((n,), jnp.uint32),
+        k2=jax.ShapeDtypeStruct((n,), jnp.uint32),
+        value=jax.ShapeDtypeStruct((n,), jnp.int32),
+        valid=jax.ShapeDtypeStruct((n,), jnp.bool_),
+    )
+    flat = jax.ShapeDtypeStruct((1 + 3 * cap,), jnp.uint32)
+    lowered = make_packed_merge_fn(app, cap).lower(state, flat)
+    try:
+        ca = lowered.compile().cost_analysis()
+    except Exception:
+        ca = lowered.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    out = {}
+    for key_name in ("flops", "bytes accessed", "transcendentals"):
+        v = ca.get(key_name)
+        if isinstance(v, (int, float)):
+            out[key_name.replace(" ", "_")] = float(v)
+    return out or None
+
+
 def _pack_update(keys: np.ndarray, values: np.ndarray, cap: int) -> np.ndarray:
     """Lay one window's (keys uint32[n,2], values) into the flat layout
     make_packed_merge_fn expects. The reference packer: allocates (and
@@ -1176,7 +1211,7 @@ class _DispatchPlane:
             return
         self._q: "queue.Queue" = queue.Queue(maxsize=self._QUEUE_DEPTH)
         self._thread = threading.Thread(
-            target=self._loop, name="merge-dispatch", daemon=True
+            target=self._loop, name="mr/dispatch", daemon=True
         )
         self._thread.start()
 
@@ -1534,7 +1569,7 @@ class _FoldShardPlane:
         self._finished = False
         self.threads = [
             threading.Thread(target=self._loop, args=(s,),
-                             name=f"fold-shard-{s}", daemon=True)
+                             name=f"mr/fold-{s}", daemon=True)
             for s in range(self.n)
         ]
         for t in self.threads:
@@ -1973,7 +2008,7 @@ def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
                       merges=len(dispatch.pending))  # benign-stale len read
         return res
 
-    pool = ThreadPoolExecutor(max_workers=workers, thread_name_prefix="host-map")
+    pool = ThreadPoolExecutor(max_workers=workers, thread_name_prefix="mr/scan")
     if fold_n > 1:
         # Started HERE, not at function entry: everything that can raise
         # during setup (device selection/state allocation, pool creation)
@@ -2847,6 +2882,19 @@ def run_job(
         registry.add_collector(jobstats_collector(stats))
         if tracer is not None:
             tracer.metrics_registry = registry  # partials keep the series
+    # Sampling profiler (ISSUE 19): one thread walks sys._current_frames()
+    # at ~97 Hz, collapsed stacks keyed by the mr/ plane-thread names.
+    # Observational only — nothing the data plane reads is touched, so
+    # outputs stay bit-identical ON vs OFF. Lands in the manifest as
+    # stats.profile (build_manifest reads the still-active profiler).
+    sprof = None
+    if cfg.profile or profile_forced():
+        from mapreduce_rust_tpu.runtime.prof import start_profiler
+
+        sprof = start_profiler(cfg.profile_hz)
+        if tracer is not None:
+            tracer.profiler = sprof  # partials keep the flamegraph
+            sprof.tracer = tracer    # per-plane self-time counter tracks
     output_files: list[str] = []
     table: dict = {}
 
@@ -2964,6 +3012,12 @@ def run_job(
         # (ROADMAP item 2) holds a bounded working set of compiled merges
         # — clear_packed_fns() is the full-drop hook for embedders.
         trim_packed_fns()
+        if sprof is not None:
+            # Freeze sampling before the artifact flush: the profile
+            # covers the job (stream/finalize/egress + spill joins), not
+            # manifest serialization. The stopped profiler stays in the
+            # global slot so build_manifest embeds its final aggregate.
+            sprof.stop()
         if tracer is not None:
             stop_tracing()
         if tracer is not None or cfg.manifest_path:
@@ -2980,6 +3034,15 @@ def run_job(
             extra: dict = {}
             if exc is not None:
                 extra["error"] = repr(exc)
+            if stats.merge_dispatches:
+                # Per-dispatch merge cost (flops / bytes accessed) for
+                # the roofline's device-merge intensity (ISSUE 19).
+                try:
+                    mc = _merge_cost_analysis(app, cfg)
+                    if mc:
+                        extra["merge_cost"] = mc
+                except Exception:
+                    pass  # telemetry stays best-effort
             tag = None
             try:
                 if jax.process_count() > 1:
@@ -3002,6 +3065,11 @@ def run_job(
             # still-active registry. Compare-and-clear: an in-process
             # co-hosted worker may have replaced the global slot.
             stop_metrics(registry)
+        if sprof is not None:
+            # Same order and compare-and-clear discipline as the registry.
+            from mapreduce_rust_tpu.runtime.prof import stop_profiler
+
+            stop_profiler(sprof)
     return JobResult(stats=stats, table=table, output_files=output_files)
 
 

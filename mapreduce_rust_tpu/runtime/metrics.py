@@ -802,7 +802,7 @@ class MetricsHTTPServer:
         self.host = host
         self.port = self._srv.server_address[1]
         self._thread = threading.Thread(
-            target=self._srv.serve_forever, name="metrics-http", daemon=True
+            target=self._srv.serve_forever, name="mr/metrics-http", daemon=True
         )
         self._thread.start()
 

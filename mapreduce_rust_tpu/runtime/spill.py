@@ -433,7 +433,7 @@ class AsyncSpillWriter:
 
     _SENTINEL = object()
 
-    def __init__(self, name: str = "spill-writer", depth: int = 2,
+    def __init__(self, name: str = "mr/spill", depth: int = 2,
                  sync: bool = False) -> None:
         self.sync = bool(sync) or sync_spill_forced()
         self.write_s = 0.0        # writer-thread seconds inside tasks

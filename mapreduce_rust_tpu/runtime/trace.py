@@ -110,6 +110,9 @@ class Tracer:
         self.metrics_registry = None    # live MetricsRegistry (ISSUE 8):
         # when set, flight-recorder partials embed the time-series ring, so
         # a SIGKILLed run keeps its sampled series alongside its events
+        self.profiler = None            # live SamplingProfiler (ISSUE 19):
+        # same contract — partials embed the live profile snapshot, so a
+        # SIGKILLed run keeps its flamegraph alongside its events
         # Flight recorder state (see enable_flight_recorder).
         self._snap_path: "str | None" = None
         self._snap_period = 5.0
@@ -300,6 +303,15 @@ class Tracer:
                     # would otherwise die with the process before any
                     # manifest flush could serialize it.
                     body["metrics"] = reg.timeseries_dict()
+                except Exception:
+                    pass  # the recorder must never fail the run
+            sprof = self.profiler
+            if sprof is not None:
+                try:
+                    # The flamegraph rides the partial too (ISSUE 19): a
+                    # SIGKILLed run's sample aggregate would otherwise die
+                    # with the process before any manifest flush.
+                    body["profile"] = sprof.profile_dict()
                 except Exception:
                     pass  # the recorder must never fail the run
             d = os.path.dirname(os.path.abspath(path))

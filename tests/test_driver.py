@@ -323,7 +323,10 @@ def test_mesh_driver_kill_and_resume_exact(tmp_path):
         cfg = Config(chunk_bytes=4096, merge_capacity=1 << 14, reduce_n=4,
                      mesh_shape=4, checkpoint_every_groups=2,
                      work_dir={str(work)!r}, output_dir={str(tmp_path / "out")!r},
-                     device="cpu")
+                     device="cpu",
+                     trace_path={str(tmp_path / "trace.json")!r},
+                     flight_record_period_s=1e-6,
+                     profile=True, profile_hz=200.0)
         drv.run_job(cfg, [{paths[0]!r}], write_outputs=False)
         print("CHILD_FINISHED")
     """)
@@ -347,6 +350,30 @@ def test_mesh_driver_kill_and_resume_exact(tmp_path):
     out = proc.stdout.read() if proc.stdout else ""
     assert ckpt.exists(), "no checkpoint was ever written"
     assert "CHILD_FINISHED" not in out, "child finished before the kill — slow the corpus down"
+
+    # The SIGKILLed run left a flight-recorder partial that embeds the
+    # LIVE profile (ISSUE 19): the flamegraph survives the kill, and the
+    # jax-free prof CLI exports it as a valid collapsed-stack file.
+    import json as _json
+
+    partial = tmp_path / "trace.partial.json"
+    assert partial.exists(), "flight recorder never snapshotted"
+    snap = _json.loads(partial.read_text())
+    prof = snap.get("profile")
+    assert prof and prof["ticks"] > 0, "partial lost the live profile"
+    assert prof["stacks"], prof
+    from mapreduce_rust_tpu.analysis.roofline import run_cli
+
+    class _Args:
+        manifest = str(partial)
+        folded = str(tmp_path / "killed.folded")
+        roofline = False
+        format = "text"
+
+    assert run_cli(_Args()) == 0
+    for line in open(_Args.folded).read().splitlines():
+        stack, count = line.rsplit(" ", 1)
+        assert int(count) > 0 and all(stack.split(";"))
 
     # Resume in-process from the journaled checkpoint; counts must be exact.
     cfg = small_cfg(tmp_path, chunk_bytes=4096, mesh_shape=4, resume=True,

@@ -1659,6 +1659,70 @@ def test_naked_clock_silent_outside_control_plane(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# unnamed-plane-thread (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+def run_lint_in_package(tmp_path, src, name="worker.py"):
+    # The rule is scoped to package source (a path with a
+    # mapreduce_rust_tpu segment): the profiler attributes samples by
+    # thread name, so only OUR planes owe one — user code is exempt.
+    pkg = tmp_path / "mapreduce_rust_tpu"
+    pkg.mkdir(exist_ok=True)
+    p = pkg / name
+    p.write_text(textwrap.dedent(src))
+    findings, errors, suppressed = lint_file(str(p))
+    assert not errors, errors
+    return sorted({f.rule for f in findings})
+
+
+def test_unnamed_plane_thread_fires_on_bare_thread(tmp_path):
+    fired = run_lint_in_package(tmp_path, """
+        import threading
+
+        def start(fn):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+            return t
+    """)
+    assert fired == ["unnamed-plane-thread"]
+
+
+def test_unnamed_plane_thread_fires_on_unprefixed_pool(tmp_path):
+    fired = run_lint_in_package(tmp_path, """
+        from concurrent.futures import ThreadPoolExecutor
+
+        def pool(n, work):
+            with ThreadPoolExecutor(max_workers=n) as ex:
+                return list(ex.map(work, range(n)))
+    """)
+    assert fired == ["unnamed-plane-thread"]
+
+
+def test_unnamed_plane_thread_silent_when_named(tmp_path):
+    assert run_lint_in_package(tmp_path, """
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+
+        def start(fn, n, work):
+            t = threading.Thread(target=fn, name="mr/spill", daemon=True)
+            with ThreadPoolExecutor(
+                    max_workers=n, thread_name_prefix="mr/scan") as ex:
+                out = list(ex.map(work, range(n)))
+            return t, out
+    """) == []
+
+
+def test_unnamed_plane_thread_silent_outside_package(tmp_path):
+    # Same snippet under a user path: not our plane, no finding.
+    assert rules_fired(tmp_path, """
+        import threading
+
+        def start(fn):
+            return threading.Thread(target=fn)
+    """) == []
+
+
+# ---------------------------------------------------------------------------
 # rpc-arg-compat (ISSUE 18)
 # ---------------------------------------------------------------------------
 

@@ -377,15 +377,26 @@ def test_flight_recorder_partial_embeds_ring(tmp_path):
         reg.gauge("g").set(42)
         reg.maybe_sample(force=True)
         tr.metrics_registry = reg
+        # ISSUE 19: the live profiler rides the recorder the same way
+        # the registry does — a SIGKILLed run keeps its flamegraph.
+        from mapreduce_rust_tpu.runtime.prof import SamplingProfiler
+
+        sprof = SamplingProfiler(hz=200.0).start()
+        tr.profiler = sprof
         tr.enable_flight_recorder(part, period_s=1e-6, min_new_events=1)
+        time.sleep(0.1)  # let the sampler tick at least once
         with trace_span("work"):
             pass
         assert tr.maybe_snapshot() == part
+        sprof.stop()
     finally:
         stop_tracing()
     snap = json.loads(pathlib.Path(part).read_text())
     assert snap["metadata"]["partial"] is True
     assert snap["metrics"]["points"][-1]["v"]["g"] == 42
+    prof = snap["profile"]
+    assert prof["ticks"] > 0
+    assert prof["planes"], prof  # a LIVE snapshot, mid-run
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,107 @@
+"""Tooling-contract gate for the ``prof`` subcommand (ISSUE 19): like
+lint/check/doctor/model, reading a manifest's profile and exporting its
+collapsed stacks must work in a process that never imports jax — the
+flamegraph of a run that died on a TPU host has to open on a laptop.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def write_manifest(tmp_path, with_profile=True):
+    stats = {"bytes_in": 1_000_000_000,
+             "host_map_split": {"scan_s": 0.5, "workers": 4}}
+    if with_profile:
+        stats["profile"] = {
+            "hz": 97.0, "wall_s": 2.0, "ticks": 194, "samples": 380,
+            "planes": {"scan": {"samples": 190, "self_s": 1.96},
+                       "router": {"samples": 190, "self_s": 1.96}},
+            "top_frames": [{"frame": "driver.py:scan:10", "samples": 190,
+                            "self_s": 1.96, "pct": 50.0}],
+            "stacks": ["mr/scan_0;driver.py:run:5;driver.py:scan:10 190",
+                       "MainThread;driver.py:run:5 190"],
+            "frame_table": {"entries": 3, "cap": 8192, "dropped": 0},
+            "stack_table": {"entries": 2, "cap": 8192, "dropped": 0},
+        }
+    path = tmp_path / "manifest.json"
+    path.write_text(json.dumps({"config": {}, "stats": stats}))
+    return path
+
+
+def run_gated(argv, timeout=60):
+    """Run `main(argv)` in a clean subprocess; exit 3 if jax snuck in."""
+    code = ("import sys; from mapreduce_rust_tpu.__main__ import main; "
+            f"rc = main({argv!r}); "
+            "sys.exit(rc if rc else (3 if 'jax' in sys.modules else 0))")
+    return subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=timeout,
+        env={"PYTHONPATH": REPO, "PATH": "/usr/bin:/bin"}, cwd=REPO,
+    )
+
+
+def test_prof_cli_is_backend_free(tmp_path):
+    manifest = write_manifest(tmp_path)
+    folded = tmp_path / "out.folded"
+    r = run_gated(["prof", str(manifest), "--folded", str(folded)])
+    assert r.returncode == 0, (r.returncode, r.stdout[-2000:],
+                               r.stderr[-500:])
+    assert "per-plane self time" in r.stdout
+    assert "scan" in r.stdout
+    # The exported file validates as collapsed-stack format.
+    lines = folded.read_text().splitlines()
+    assert len(lines) == 2
+    for line in lines:
+        stack, count = line.rsplit(" ", 1)
+        assert int(count) > 0
+        assert all(fr and " " not in fr for fr in stack.split(";"))
+
+
+def test_prof_cli_roofline_stays_jax_free(tmp_path):
+    # --roofline with a pre-written calibration: attribution math only,
+    # no probe, no backend. The machine file keeps the run off the
+    # repo's real .bench/machine.json.
+    manifest = write_manifest(tmp_path)
+    machine = tmp_path / "machine.json"
+    machine.write_text(json.dumps(
+        {"schema": 1, "host_memcpy_gbs": 4.0, "devices": []}))
+    r = run_gated(["prof", str(manifest), "--roofline",
+                   "--machine", str(machine), "--format", "json"])
+    assert r.returncode == 0, (r.returncode, r.stdout[-2000:],
+                               r.stderr[-500:])
+    doc = json.loads(r.stdout)
+    assert doc["roofline"]["scan_achieved_gbs"] == 2.0
+    assert doc["roofline"]["roofline_frac"] == 0.5
+    assert not machine.read_text().startswith("{}")  # untouched cache
+
+
+def test_prof_cli_without_profile_says_so(tmp_path):
+    manifest = write_manifest(tmp_path, with_profile=False)
+    r = run_gated(["prof", str(manifest)])
+    assert r.returncode == 0, (r.returncode, r.stdout, r.stderr[-500:])
+    assert "profile: none" in r.stdout
+    # But asking for a folded export with nothing to export is an error.
+    r2 = run_gated(["prof", str(manifest),
+                    "--folded", str(tmp_path / "x.folded")])
+    assert r2.returncode == 2, (r2.returncode, r2.stdout)
+
+
+def test_prof_cli_reads_flight_recorder_partial(tmp_path):
+    # Partials carry the profile at the TOP level (the metrics-embed
+    # pattern in trace.maybe_snapshot), not under stats.
+    body = {"partial": True,
+            "profile": {"hz": 97.0, "wall_s": 1.0, "ticks": 97,
+                        "samples": 97,
+                        "planes": {"scan": {"samples": 97, "self_s": 1.0}},
+                        "top_frames": [],
+                        "stacks": ["mr/scan_0;driver.py:scan:10 97"]}}
+    path = tmp_path / "trace.partial.json"
+    path.write_text(json.dumps(body))
+    folded = tmp_path / "partial.folded"
+    r = run_gated(["prof", str(path), "--folded", str(folded)])
+    assert r.returncode == 0, (r.returncode, r.stdout, r.stderr[-500:])
+    assert folded.read_text().strip().endswith(" 97")
