@@ -873,6 +873,143 @@ def profile_overhead_leg(path: str) -> None:
     }))
 
 
+def lineage_overhead_leg(path: str) -> None:
+    """Runs in a subprocess (--lineage-overhead): the ISSUE 20 provenance
+    plane's two numbers in one leg.
+
+    1. Ledger tax: the metrics/profile overhead estimator verbatim
+       (min-of-N, interleaved sides, bit-identical outputs gate) with
+       ``Config.lineage`` as the toggled knob — one blake2b per window in
+       the scan thread plus one flushed jsonl line per chunk/partition.
+       Acceptance bar ≤ 2% wall; `doctor trend` watches
+       lineage_overhead_frac (bad: up).
+    2. Blast radius: grow the corpus ~1% (a new file appended to the
+       input list — the incremental-ingest shape ROADMAP item 4 memoizes),
+       re-run with lineage on, diff the two ledgers. memo_hit_frac is the
+       byte fraction a memo tier could skip (acceptance ≥ 0.95 — chunking
+       must be stable for unchanged files); `doctor trend` watches
+       lineage_memo_hit_frac (bad: down)."""
+    import jax
+
+    platform = jax.devices()[0].platform
+    print(f"BENCH_DEVICE_READY {platform}", file=sys.stderr, flush=True)
+
+    import dataclasses
+
+    from mapreduce_rust_tpu.config import Config
+    from mapreduce_rust_tpu.runtime.driver import (
+        enable_compilation_cache,
+        run_job,
+    )
+
+    enable_compilation_cache("auto")
+    out_root = BENCH_DIR / "lineage-overhead"
+    base = Config(
+        map_engine="host",
+        host_map_workers=_env_host_workers(),
+        fold_shards=_env_fold_shards(),
+        host_window_bytes=16 << 20,
+        chunk_bytes=1 << 20,
+        merge_capacity=1 << 17,
+        reduce_n=4,
+        output_dir=str(out_root / "out"),
+        device="auto",
+    )
+
+    warm = BENCH_DIR / "warmup-overhead.txt"
+    with open(path, "rb") as f:
+        warm.write_bytes(f.read(base.host_window_bytes + 4096))
+    run_job(dataclasses.replace(base, lineage=False),
+            [str(warm)], write_outputs=False)
+
+    def one(enabled: bool) -> tuple[float, float, dict]:
+        side = "on" if enabled else "off"
+        cfg = dataclasses.replace(
+            base, lineage=enabled,
+            work_dir=str(out_root / f"work-{side}"),
+            output_dir=str(out_root / f"out-{side}"),
+        )
+        c0 = time.process_time()
+        t0 = time.perf_counter()
+        run_job(cfg, [str(path)])
+        wall = time.perf_counter() - t0
+        cpu = time.process_time() - c0
+        outputs = {
+            p.name: p.read_bytes()
+            for p in sorted(pathlib.Path(cfg.output_dir).glob("mr-*.txt"))
+        }
+        return wall, cpu, outputs
+
+    repeats = 15
+    walls: dict = {"on": [], "off": []}
+    cpus: dict = {"on": [], "off": []}
+    outputs: dict = {}
+    identical = True
+    for i in range(repeats):
+        for enabled in ((True, False) if i % 2 == 0 else (False, True)):
+            wall, cpu, out = one(enabled)
+            side = "on" if enabled else "off"
+            walls[side].append(wall)
+            cpus[side].append(cpu)
+            if not out:
+                identical = False
+            elif not outputs:
+                outputs = out
+            elif out != outputs:
+                identical = False
+    on_s, off_s = min(walls["on"]), min(walls["off"])
+    frac = (on_s - off_s) / off_s if off_s > 0 else None
+    cpu_on, cpu_off = min(cpus["on"]), min(cpus["off"])
+    cpu_frac = (cpu_on - cpu_off) / cpu_off if cpu_off > 0 else None
+
+    # Blast radius: +~1% new file (cut at whitespace so the tokenizer
+    # sees whole words), ledgers diffed jax-free. The base-side ledger is
+    # the pair loop's last ON run — same corpus, same window policy.
+    blast: dict | None = None
+    try:
+        from mapreduce_rust_tpu.analysis import lineage as lin
+
+        grow = pathlib.Path(path).stat().st_size // 100
+        extra = out_root / "grown-extra.txt"
+        with open(path, "rb") as f:
+            f.seek(-min(grow + (1 << 16), f.seek(0, 2)), 2)
+            tail = f.read()
+        cut = next((i for i, b in enumerate(tail) if b in _WS), 0)
+        extra.write_bytes(tail[cut:cut + grow])
+        run_job(
+            dataclasses.replace(
+                base, lineage=True,
+                work_dir=str(out_root / "work-grown"),
+                output_dir=str(out_root / "out-grown"),
+            ),
+            [str(path), str(extra)],
+        )
+        d = lin.diff(lin.load_ledger(str(out_root / "work-on")),
+                     lin.load_ledger(str(out_root / "work-grown")))
+        blast = {
+            "grown_bytes": extra.stat().st_size,
+            "memo_hit_frac": round(d["memo_hit_frac"], 5),
+            "changed_chunks": d["changed_chunks"],
+            "affected_partition_frac": round(
+                d["affected_partition_frac"], 5),
+        }
+    except Exception as e:
+        blast = {"error": repr(e)}
+    print(json.dumps({
+        "lineage_overhead": {
+            "platform": platform,
+            "bytes": pathlib.Path(path).stat().st_size,
+            "runs_per_side": repeats,
+            "on_s": round(on_s, 4),
+            "off_s": round(off_s, 4),
+            "frac": round(frac, 5) if frac is not None else None,
+            "cpu_frac": round(cpu_frac, 5) if cpu_frac is not None else None,
+            "outputs_identical": identical,
+            "blast_radius": blast,
+        }
+    }))
+
+
 def _ws_aligned_slices(path: pathlib.Path, n: int, limit: int | None = None):
     """n byte ranges cut at whitespace (reading only boundary probes)."""
     size = min(path.stat().st_size, limit or (1 << 62))
@@ -2511,6 +2648,27 @@ def main() -> None:
             if prof_overhead is None:
                 errors.append(f"profile-overhead: {perr}")
 
+    # Provenance-plane pair (ISSUE 20): ledger tax + blast radius in one
+    # leg. The series doctor `trend` watches are lineage_overhead_frac
+    # (bad: up, bar 2%) and lineage_memo_hit_frac (bad: down, bar 0.95).
+    lin_overhead, lerr = None, None
+    if overhead_mb > 0 and os.environ.get("BENCH_LINEAGE_OVERHEAD", "1") != "0":
+        try:
+            lin_corpus = build_corpus(min(TARGET_MB, overhead_mb))
+        except Exception as e:
+            errors.append(f"lineage-overhead corpus: {e!r}")
+            lin_corpus = None
+        if lin_corpus is not None:
+            lin_overhead, lerr = _run_device_leg(
+                lin_corpus,
+                int(os.environ.get("BENCH_METRICS_OVERHEAD_TIMEOUT_S", "300")),
+                _cpu_env(), init_timeout_s=PROBE_TIMEOUT_S,
+                mode="--lineage-overhead",
+            )
+            note_probe("lineage-overhead", lin_overhead, lerr)
+            if lin_overhead is None:
+                errors.append(f"lineage-overhead: {lerr}")
+
     value = round(dev["gbs"], 4) if dev else None
     platform = dev["info"].get("platform", "unknown") if dev else "none"
     # The corpus label comes from the bytes the measured leg actually
@@ -2545,6 +2703,8 @@ def main() -> None:
         result["metrics_overhead"] = overhead.get("metrics_overhead")
     if prof_overhead is not None:
         result["profile_overhead"] = prof_overhead.get("profile_overhead")
+    if lin_overhead is not None:
+        result["lineage_overhead"] = lin_overhead.get("lineage_overhead")
     if errors:
         result["error"] = "; ".join(errors)
     result["doctor"] = _doctor_measured_leg(dev)
@@ -2642,6 +2802,16 @@ def _append_history(result: dict) -> None:
             # watched with bad direction: up; acceptance bar is 0.02.
             "profile_overhead_frac": (
                 (result.get("profile_overhead") or {}).get("frac")
+            ),
+            # Provenance plane (ISSUE 20): ledger tax (bad: up, bar 2%)
+            # and the +1% grown-corpus memo fraction (bad: down — chunk
+            # stability eroding shrinks what a memo tier can ever skip).
+            "lineage_overhead_frac": (
+                (result.get("lineage_overhead") or {}).get("frac")
+            ),
+            "lineage_memo_hit_frac": (
+                ((result.get("lineage_overhead") or {}).get("blast_radius")
+                 or {}).get("memo_hit_frac")
             ),
             "had_errors": bool(result.get("error")),
         }
@@ -2936,6 +3106,8 @@ if __name__ == "__main__":
         metrics_overhead_leg(sys.argv[2])
     elif len(sys.argv) > 1 and sys.argv[1] == "--profile-overhead":
         profile_overhead_leg(sys.argv[2])
+    elif len(sys.argv) > 1 and sys.argv[1] == "--lineage-overhead":
+        lineage_overhead_leg(sys.argv[2])
     elif len(sys.argv) > 1 and sys.argv[1] == "--zipf":
         zipf_leg(int(sys.argv[2]))
     elif len(sys.argv) > 1 and sys.argv[1] == "--zipf-ii":

@@ -112,6 +112,14 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    dest="profile_hz", metavar="HZ",
                    help="sampler rate (default 97 — prime, never "
                    "phase-locks with periodic work)")
+    p.add_argument("--lineage", action="store_true",
+                   help="chunk-level provenance ledger (runtime/"
+                   "lineage.py): per-chunk content digests + partition "
+                   "routing to {work}/lineage.jsonl, summarized in the "
+                   "manifest as stats.lineage; query with the `lineage` "
+                   "subcommand. Off by default (observational only — "
+                   "outputs are bit-identical; MR_LINEAGE=1 for a "
+                   "process tree)")
     p.add_argument("--metrics-period", type=float, default=1.0,
                    dest="metrics_period", metavar="SECONDS",
                    help="wall-clock bucket width of the live time-series "
@@ -224,6 +232,7 @@ def _cfg(args, map_n: int = 1, worker_n: int = 1) -> Config:
         ),
         profile=getattr(args, "profile", False),
         profile_hz=getattr(args, "profile_hz", 97.0) or 97.0,
+        lineage=getattr(args, "lineage", False),
         metrics_enabled=not getattr(args, "no_metrics", False),
         metrics_sample_period_s=getattr(args, "metrics_period", 1.0) or 1.0,
         metrics_ring_points=getattr(args, "metrics_ring", 512) or 512,
@@ -764,6 +773,15 @@ def cmd_prof(args) -> int:
     return run_cli(args)
 
 
+def cmd_lineage(args) -> int:
+    """mrlineage (ISSUE 20): provenance queries + recompute blast radius
+    over a run's lineage ledger. Backend-free like check/lint/doctor —
+    reads jsonl/manifest/partial artifacts, never initializes jax."""
+    from mapreduce_rust_tpu.analysis.lineage import run_cli
+
+    return run_cli(args)
+
+
 def cmd_fleet(args) -> int:
     """Fleet profiler (ISSUE 16): cross-job utilization timeline,
     barrier-bubble accounting, pipelining opportunity. Backend-free like
@@ -1105,6 +1123,33 @@ def main(argv: list[str] | None = None) -> int:
                    help="json: the full document for CI diffs")
     p.add_argument("-v", "--verbose", action="store_true")
 
+    p = sub.add_parser(
+        "lineage",
+        help="mrlineage: chunk-level provenance queries over a run's "
+        "lineage.jsonl — forward (chunk → partitions), backward "
+        "(partition → chunks + attempt chain), and `lineage diff "
+        "<old> <new>` recompute blast radius (memo_hit_frac)",
+    )
+    p.add_argument("target", nargs="+",
+                   help="a lineage.jsonl, a work dir holding one, a run "
+                   "manifest (stats.lineage), or a flight-recorder "
+                   "*.partial.json (its embedded tail) — or the literal "
+                   "'diff' followed by two such targets (old, new)")
+    p.add_argument("--forward", default=None, metavar="CHUNK",
+                   help="forward query: ledger seq or digest prefix → "
+                   "the reduce partitions the chunk contributed to")
+    p.add_argument("--backward", default=None, metavar="R", type=int,
+                   help="backward query: reduce partition → contributing "
+                   "chunks (digests, bytes, docs) + attempt chain; "
+                   "exit 2 when the set is empty")
+    p.add_argument("--stamp", action="store_true",
+                   help="(diff) write memo_hit_frac / blast radius into "
+                   "the NEW target's manifest stats.lineage block — the "
+                   "doctor's incremental-opportunity finding cites it")
+    p.add_argument("--format", choices=["text", "json"], default="text",
+                   help="json: the full document for CI diffs")
+    p.add_argument("-v", "--verbose", action="store_true")
+
     p = sub.add_parser("stats", help="pretty-print a run manifest, or diff two")
     p.add_argument("manifest", help="manifest.json of a run")
     p.add_argument("other", nargs="?", default=None,
@@ -1249,6 +1294,7 @@ def main(argv: list[str] | None = None) -> int:
         "model": cmd_model,
         "fleet": cmd_fleet,
         "prof": cmd_prof,
+        "lineage": cmd_lineage,
     }[args.cmd](args)
 
 

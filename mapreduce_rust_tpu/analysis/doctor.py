@@ -359,6 +359,31 @@ def diagnose(manifest: dict, job_report: "dict | None" = None,
                          + (f" (projected ~{proj:g}× at half the target "
                             "roof)" if proj else ""))
 
+        # ---- lineage / incremental opportunity (ISSUE 20) ----
+        # A stamped blast-radius diff turns "rerun everything" into a
+        # measured number: memo_hit_frac of bytes whose chunk digests are
+        # unchanged — exactly the fraction a memoizing re-run (ROADMAP
+        # item 4) would skip.
+        lin = stats.get("lineage") or {}
+        if lin.get("chunks"):
+            diag["lineage"] = lin
+            hit = lin.get("memo_hit_frac")
+            if hit is not None:
+                find("info", "incremental-opportunity",
+                     f"provenance ledger covers {lin['chunks']} chunks "
+                     f"({lin.get('bytes', 0)} bytes) and the stamped diff "
+                     f"shows {hit:.1%} of input bytes unchanged since the "
+                     f"baseline ({lin.get('changed_chunks', 0)} chunks "
+                     "changed) — incremental re-execution (ROADMAP item 4) "
+                     f"could memo-skip ~{hit:.0%} of the map work")
+            else:
+                find("info", "incremental-opportunity",
+                     f"provenance ledger covers {lin['chunks']} chunks "
+                     f"({lin.get('bytes', 0)} bytes); run `mapreduce_rust_tpu "
+                     "lineage diff <old> <new> --stamp` against a prior run "
+                     "to measure the recompute blast radius incremental "
+                     "re-execution (ROADMAP item 4) would avoid")
+
     # ---- percentiles ----
     hists = {
         name: h.summary(scale=1e3, digits=3)  # seconds → ms
@@ -1000,6 +1025,14 @@ TREND_SERIES: dict[str, str] = {
     # min-of-N estimate; creeping UP is the profiler outgrowing its ≤2%
     # budget (the metrics_overhead_frac twin).
     "profile_overhead_frac": "up",
+    # Provenance plane (ISSUE 20): the --lineage-overhead pair's ledger
+    # tax creeping UP is the digest/ledger path outgrowing its ≤2%
+    # budget; the blast-radius leg's memo_hit_frac drifting DOWN on the
+    # fixed +1% grown corpus means chunking stability eroded — a window
+    # boundary shift silently shrinking what incremental re-execution
+    # (ROADMAP item 4) could ever skip.
+    "lineage_overhead_frac": "up",
+    "lineage_memo_hit_frac": "down",
 }
 
 

@@ -164,6 +164,15 @@ INVARIANTS: dict[str, tuple[str, str]] = {
         "per-partition release can never hand a reducer a partition "
         "whose inputs are still being written)",
     ),
+    "lineage-conservation": (
+        "lineage",
+        "provenance conserves data: every partition's claimed chunk set "
+        "⊆ the chunks some finished attempt (or the driver's scan) "
+        "actually digested — an output claiming an unscanned chunk is "
+        "fabricated provenance — and a re-executed attempt's chunk list "
+        "equals its expired predecessor's (ISSUE 20: determinism is what "
+        "makes re-execution a recovery, not a different job)",
+    ),
 }
 
 
@@ -920,6 +929,64 @@ def _service_journal_pass(target: str, checked: dict,
     violations.extend(x.to_dict() for x in check_service_journal(rows))
 
 
+def check_lineage(led: dict) -> list[Violation]:
+    """Conservation pass over one parsed ledger (ISSUE 20): claims must
+    be scanned, re-executions must agree. Takes analysis.lineage's
+    load_ledger dict so mrcheck and the query CLI share one parser."""
+    out: list[Violation] = []
+    scanned: set = {c.get("dg") for c in led["chunks"] if c.get("dg")}
+    for a in led["attempts"]:
+        scanned.update(a.get("chunks") or [])
+    for p in led["parts"]:
+        ghost = sorted(set(p.get("chunks") or []) - scanned)
+        if ghost:
+            out.append(Violation(
+                "lineage-conservation",
+                f"partition {p.get('r')} claims {len(ghost)} chunk(s) no "
+                "attempt or scan ever digested (fabricated provenance): "
+                f"{ghost[:3]}{'…' if len(ghost) > 3 else ''}",
+                [p],
+            ))
+    by_task: dict = {}
+    for a in led["attempts"]:
+        by_task.setdefault((a.get("phase"), a.get("tid")), []).append(a)
+    for (phase, tid), atts in by_task.items():
+        base = atts[0]
+        for a in atts[1:]:
+            if a.get("chunks") != base.get("chunks"):
+                out.append(Violation(
+                    "lineage-conservation",
+                    f"{phase} {tid}: attempt {a.get('attempt')} scanned a "
+                    f"different chunk list than attempt "
+                    f"{base.get('attempt')} — re-execution diverged from "
+                    "its predecessor (nondeterministic ingest or wrong "
+                    "inputs)",
+                    [base, a],
+                ))
+    return out
+
+
+def _lineage_pass(target: str, checked: dict, violations: list) -> None:
+    """Run the lineage-conservation invariant over ``<target>/
+    lineage.jsonl`` when present (a --lineage run's work dir — driver or
+    cluster). Appends Violation dicts; torn/partial ledgers check
+    whatever records survived (the recorder's crash-durability contract
+    means a SIGKILLed run's ledger is still a valid, shorter ledger)."""
+    lpath = os.path.join(target, "lineage.jsonl")
+    if not os.path.isfile(lpath):
+        return
+    from mapreduce_rust_tpu.analysis.lineage import LineageError, load_ledger
+
+    try:
+        led = load_ledger(lpath)
+    except LineageError:
+        return  # unreadable ledger — nothing checkable
+    checked["lineage_records"] = (len(led["chunks"]) + len(led["attempts"])
+                                  + len(led["parts"]))
+    checked["sources"]["lineage"] = lpath
+    violations.extend(x.to_dict() for x in check_lineage(led))
+
+
 def _service_job_dirs(target: str) -> list:
     """job-* subdirs of a JobService work root that hold checkable
     artifacts (per-job journal or job report)."""
@@ -1086,8 +1153,10 @@ def run_check(target: str, trace: "str | None" = None,
     if os.path.isdir(target):
         # A single-job work dir can carry the admission journal that
         # admitted it (mutation fixtures, copied service legs) — the
-        # lifecycle machine runs wherever the artifact lands.
+        # lifecycle machine runs wherever the artifact lands. Same for a
+        # --lineage run's provenance ledger.
         _service_journal_pass(target, checked, vdicts)
+        _lineage_pass(target, checked, vdicts)
     return {
         "tool": "mrcheck",
         "schema": CHECK_SCHEMA,
@@ -1394,6 +1463,34 @@ def mutate_early_reduce_grant(workdir: str) -> str:
     return "early-reduce-grant"
 
 
+def mutate_lineage_conservation(workdir: str) -> str:
+    """Corrupt (or synthesize) the work dir's provenance ledger so a
+    partition claims a chunk digest nothing ever scanned — the
+    fabricated-provenance half of the invariant. Runs on recordings made
+    without --lineage too (the job-lifecycle synthesize precedent): the
+    pass arms on the file's presence, not on how the run was configured."""
+    path = os.path.join(workdir, "lineage.jsonl")
+    ghost = "deadbeef" * 4  # 32 hex chars no scan could have produced
+    rows = []
+    if os.path.exists(path):
+        with open(path) as f:
+            rows = [line.rstrip("\n") for line in f if line.strip()]
+    if not rows:
+        rows = [
+            json.dumps({"t": "start", "schema": 1,
+                        "corpus_meta_digest": "0" * 16,
+                        "corpus_bytes": 64, "reduce_n": 1,
+                        "inputs": ["doc0.txt"], "pid": 0}),
+            json.dumps({"t": "chunk", "seq": 0, "doc": 0, "bytes": 64,
+                        "dg": "ab" * 16, "parts": [0]}),
+        ]
+    rows.append(json.dumps({"t": "part", "r": 0, "bytes": 64,
+                            "chunks": [ghost]}))
+    with open(path, "w") as f:
+        f.write("\n".join(rows) + "\n")
+    return "lineage-conservation"
+
+
 #: name -> (needs_trace, mutator). The seeded-violation fixture table:
 #: every entry corrupts a RECORDED run's artifacts so the named invariant
 #: fires with the offending event pair — proving the checker detects it —
@@ -1416,4 +1513,5 @@ MUTATIONS: dict = {
     "grant-across-jobs": (False, mutate_grant_across_jobs),
     "job-lifecycle": (False, mutate_job_lifecycle),
     "early-reduce-grant": (False, mutate_early_reduce_grant),
+    "lineage-conservation": (False, mutate_lineage_conservation),
 }

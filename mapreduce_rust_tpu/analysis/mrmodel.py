@@ -61,6 +61,7 @@ from mapreduce_rust_tpu.analysis.mrcheck import (
     JournalLine,
     MUTATIONS,
     Violation,
+    check_lineage,
     check_service_journal,
     check_stream,
     check_trace,
@@ -848,6 +849,24 @@ def _mut_early_reduce_grant(a: dict) -> bool:
     return True
 
 
+def _mut_lineage_claim(a: dict) -> bool:
+    # Mirrors mrcheck.mutate_lineage_conservation: a partition claims a
+    # chunk digest no scan or attempt ever produced. The model has no
+    # data plane, so the ledger is synthesized in load_ledger's parsed
+    # shape (the file mutator's synthesize precedent) — one honestly
+    # scanned chunk plus a part record smuggling a ghost digest into its
+    # claim. Any leaf can host it, so the shrunk schedule is just the
+    # arming event.
+    a["lineage"] = {
+        "chunks": [{"t": "chunk", "seq": 0, "doc": 0, "bytes": 64,
+                    "dg": "ab" * 16, "parts": [0]}],
+        "attempts": [],
+        "parts": [{"t": "part", "r": 0, "bytes": 64,
+                   "chunks": ["ab" * 16, "deadbeef" * 4]}],
+    }
+    return True
+
+
 #: In-memory corruption per mrcheck.MUTATIONS class: same keys, same
 #: violation codes, applied to a leaf's captured artifacts instead of
 #: files on disk. A mutator returns False when the schedule cannot host
@@ -868,6 +887,7 @@ MODEL_MUTATORS: dict = {
     "missing-terminator": _mut_drop_terminator,
     "write-race": _mut_write_race,
     "early-reduce-grant": _mut_early_reduce_grant,
+    "lineage-conservation": _mut_lineage_claim,
 }
 
 #: Which focus hosts each mutation class (the teeth test's routing):
@@ -889,6 +909,8 @@ def _validate_mutated(a: dict) -> list[Violation]:
             v += check_trace(a["trace"], a.get("journal"))
         except ValueError:
             pass
+    if a.get("lineage") is not None:
+        v += check_lineage(a["lineage"])
     return v
 
 

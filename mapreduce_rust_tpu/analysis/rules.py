@@ -1957,6 +1957,88 @@ class UnnamedPlaneThreadRule(Rule):
                 )
 
 
+class AdHocCorpusDigestRule(Rule):
+    """Corpus/chunk bytes get hashed through the lineage seam, not
+    ad-hoc hashlib calls.
+
+    Incident: ISSUE 20's provenance plane keys everything — forward and
+    backward queries, the blast-radius diff, the service result-cache
+    cross-check — on ONE pair of digest definitions
+    (``runtime.lineage.chunk_digest`` over raw chunk bytes,
+    ``corpus_fingerprint`` over name:size:mtime metadata). A second
+    ad-hoc digest of the same bytes elsewhere drifts independently
+    (different algorithm, different truncation, pre- vs post-
+    normalization bytes) and the planes silently stop agreeing: a cache
+    hit keyed one way can't be cross-checked against a ledger keyed the
+    other. Scoped to the installed package; the lineage module itself
+    and the service's ``scan_corpus`` seam (which IS the metadata
+    fingerprint) are the two legitimate homes.
+    """
+
+    name = "ad-hoc-corpus-digest"
+    summary = "hashlib over corpus/chunk bytes outside the " \
+              "runtime.lineage digest seam"
+
+    CTORS = {"blake2b", "sha256", "sha1", "md5", "sha512", "sha3_256"}
+    HOT = ("chunk", "window", "payload", "corpus")
+    EXEMPT_FUNCS = {"scan_corpus", "scan_corpus_spec"}
+
+    def _hot_arg(self, node) -> "str | None":
+        """First plain Name in the subtree whose id smells like corpus
+        bytes. Names only — attribute mentions like cfg.chunk_bytes are
+        shape knobs feeding config fingerprints, not the bytes
+        themselves."""
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name):
+                low = n.id.lower()
+                if any(w in low for w in self.HOT):
+                    return n.id
+        return None
+
+    def run(self, tree, src, path):
+        parts = path.replace("\\", "/").split("/")
+        if "mapreduce_rust_tpu" not in parts:
+            return
+        if "/".join(parts[-2:]) == "runtime/lineage.py":
+            return
+        exempt: set[int] = set()
+        hashed: set[str] = set()
+        for node in ast.walk(tree):
+            if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and node.name in self.EXEMPT_FUNCS):
+                exempt.update(id(n) for n in ast.walk(node))
+            elif (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and _last_segment(
+                        qualname(node.value.func)) in self.CTORS):
+                hashed.update(t.id for t in node.targets
+                              if isinstance(t, ast.Name))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or id(node) in exempt:
+                continue
+            fn = _last_segment(qualname(node.func))
+            is_ctor = fn in self.CTORS
+            is_update = (
+                fn == "update" and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in hashed
+            )
+            if not (is_ctor or is_update) or not node.args:
+                continue
+            hot = self._hot_arg(node.args[0])
+            if hot is None:
+                continue
+            yield self.finding(
+                path, node,
+                f"ad-hoc {fn}(...{hot}...) digest of corpus/chunk bytes "
+                "— every plane keys on the lineage seam; use "
+                "runtime.lineage.chunk_digest for content or "
+                "corpus_fingerprint for file metadata so digests stay "
+                "comparable across the ledger, the result cache, and "
+                "the coordinator journal",
+            )
+
+
 ALL_RULES: list[Rule] = [
     StatsOwnershipRule(),
     ExecutorTeardownRule(),
@@ -1971,6 +2053,7 @@ ALL_RULES: list[Rule] = [
     MetricInHotLoopRule(),
     NakedClockInControlPlaneRule(),
     UnnamedPlaneThreadRule(),
+    AdHocCorpusDigestRule(),
 ]
 
 #: Interprocedural rules: run once per lint over the whole file set, on

@@ -37,6 +37,18 @@ def profile_forced() -> bool:
     )
 
 
+def lineage_forced() -> bool:
+    """``MR_LINEAGE`` — process-tree opt-in to the provenance ledger
+    (ISSUE 20; the MR_PROFILE enablement pattern): fleet workers and
+    SIGKILL-test subprocesses inherit lineage recording without plumbing
+    a flag through their argv. Canonical definition lives in
+    runtime/lineage.py (the jax-free seam the analysis CLI imports);
+    re-exported here so config-reading call sites have one import."""
+    from mapreduce_rust_tpu.runtime.lineage import lineage_forced as _lf
+
+    return _lf()
+
+
 @dataclasses.dataclass
 class Config:
     # ---- Job shape (reference: argv of mrcoordinator/mrworker) ----
@@ -318,6 +330,21 @@ class Config:
                                     # --profile-overhead pair.
     profile_hz: float = 97.0        # sampler rate; prime, so it never
                                     # phase-locks with 1/10/100 Hz work
+
+    # ---- Provenance ledger (ISSUE 20) ----
+    lineage: bool = False           # chunk-level data lineage
+                                    # (runtime/lineage.py): per-chunk
+                                    # blake2b content digests + partition
+                                    # routing recorded to
+                                    # {work_dir}/lineage.jsonl and
+                                    # summarized as stats.lineage; the
+                                    # `lineage` CLI answers forward/
+                                    # backward/blast-radius queries.
+                                    # Observational only — outputs stay
+                                    # bit-identical ON vs OFF. Off by
+                                    # default (--lineage / MR_LINEAGE=1);
+                                    # tax gated ≤2% by bench's
+                                    # --lineage-overhead pair.
 
     # ---- Fleet scheduler (ISSUE 17) ----
     sched: str = "fifo"             # task-grant scheduling mode. "fifo"

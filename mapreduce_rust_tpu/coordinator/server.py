@@ -400,6 +400,17 @@ class Coordinator:
         self.fleet: dict[int, dict] = {}  # wid → latest envelope sample
         self._live_findings: dict[str, dict] = {}  # key → finding+first_seen
         self._journal_path = os.path.join(cfg.work_dir, "coordinator.journal")
+        # Provenance ledger, cluster side (ISSUE 20): armed by the first
+        # finish report that carries a lineage payload (workers opt in via
+        # Config.lineage / MR_LINEAGE — the coordinator needs no flag of
+        # its own). Attempt records are appended for EVERY report, late
+        # duplicates included: two reports for the same (tid) naming
+        # different chunk lists is exactly the re-execution-divergence
+        # evidence mrcheck's lineage-conservation invariant looks for.
+        self._lineage_path = os.path.join(cfg.work_dir, "lineage.jsonl")
+        self._lineage_started = False
+        self._lineage_chunks: dict[int, list] = {}  # tid → first-report chunks
+        self._lineage_pb: dict[int, list] = {}      # tid → first part_bytes
         if resume:
             self._replay_journal()
 
@@ -408,19 +419,18 @@ class Coordinator:
     def _header(self) -> str:
         """Job identity line: shape + a fingerprint of the input listing
         (name, size, mtime per file) — a rerun over different inputs in the
-        same work_dir must start fresh, not resume the stale journal."""
+        same work_dir must start fresh, not resume the stale journal.
+        The fingerprint is runtime.lineage.corpus_fingerprint — the SAME
+        formula the service's result-cache corpus key and the lineage
+        ledger header use (ISSUE 20's one-digest-seam contract), so all
+        three agree byte-for-byte about corpus identity."""
         import glob
-        import hashlib
 
-        sig = hashlib.sha256()
+        from mapreduce_rust_tpu.runtime.lineage import corpus_fingerprint
+
         paths = sorted(glob.glob(os.path.join(self.cfg.input_dir, self.cfg.input_pattern)))
-        for p in paths:
-            try:
-                st = os.stat(p)
-                sig.update(f"{os.path.basename(p)}:{st.st_size}:{st.st_mtime_ns};".encode())
-            except OSError:
-                sig.update(f"{os.path.basename(p)}:gone;".encode())
-        return f"job {self.cfg.map_n} {self.cfg.reduce_n} {sig.hexdigest()[:16]}"
+        dg, _total = corpus_fingerprint(paths)
+        return f"job {self.cfg.map_n} {self.cfg.reduce_n} {dg}"
 
     def _replay_journal(self) -> None:
         try:
@@ -510,6 +520,52 @@ class Coordinator:
                           attempt=attempt, wid=wid, **self._job_args())
         except OSError as e:
             log.warning("journal write failed: %s", e)
+
+    # ---- provenance ledger, cluster side (ISSUE 20) ----
+
+    @staticmethod
+    def _valid_chunks(lineage) -> "list | None":
+        """Validate a report's lineage payload (remote input, same
+        posture as _record_readiness: malformed ⇒ drop, never raise).
+        Expected shape: {"chunks": [hex digest, ...]}."""
+        if not isinstance(lineage, dict):
+            return None
+        chunks = lineage.get("chunks")
+        if not isinstance(chunks, list) or len(chunks) > (1 << 16):
+            return None
+        for dg in chunks:
+            if not isinstance(dg, str) or not (8 <= len(dg) <= 128):
+                return None
+        return list(chunks)
+
+    def _lineage_append(self, rec: dict) -> None:
+        """Append one ledger record, writing the start header first on
+        this incarnation's first append (truncating — like the journal, a
+        fresh coordinator owns its work dir's provenance). Best-effort:
+        an unwritable ledger must never fail a finish report."""
+        from mapreduce_rust_tpu.runtime import lineage as _lin
+
+        try:
+            os.makedirs(self.cfg.work_dir, exist_ok=True)
+            if not self._lineage_started:
+                import glob
+
+                paths = sorted(glob.glob(os.path.join(
+                    self.cfg.input_dir, self.cfg.input_pattern)))
+                meta_dg, total = _lin.corpus_fingerprint(paths)
+                with open(self._lineage_path, "w") as f:
+                    f.write(json.dumps({
+                        "t": "start", "schema": _lin.SCHEMA,
+                        "corpus_meta_digest": meta_dg,
+                        "corpus_bytes": total,
+                        "reduce_n": self.cfg.reduce_n,
+                        "inputs": [os.path.basename(p) for p in paths],
+                        "pid": os.getpid(),
+                    }, separators=(",", ":")) + "\n")
+                self._lineage_started = True
+            _lin.append_record(self._lineage_path, rec)
+        except OSError as e:
+            log.warning("lineage append failed: %s", e)
 
     # ---- the 7 RPCs (coordinator.rs:102-111) ----
 
@@ -799,23 +855,62 @@ class Coordinator:
 
     def report_map_task_finish(self, tid: int, attempt: int = 0,
                                wid: int = -1, job=None,
-                               part_bytes=None) -> bool:
-        # ``job``/``part_bytes`` are trailing default RPC fields (the
-        # wid/sample wire-compat pattern): old clients omit both. job is
-        # accepted-and-ignored here so the 5-positional service-worker
-        # report stays valid against a classic coordinator; part_bytes is
-        # the map task's per-reduce-partition intermediate-bytes vector —
-        # recorded on the FIRST report only (a late duplicate re-wrote
-        # identical shard files; readiness was already achieved).
-        if part_bytes is not None and tid not in self.map.reported:
+                               part_bytes=None, lineage=None) -> bool:
+        # ``job``/``part_bytes``/``lineage`` are trailing default RPC
+        # fields (the wid/sample wire-compat pattern): old clients omit
+        # all three. job is accepted-and-ignored here so the 5-positional
+        # service-worker report stays valid against a classic coordinator;
+        # part_bytes is the map task's per-reduce-partition
+        # intermediate-bytes vector — recorded on the FIRST report only
+        # (a late duplicate re-wrote identical shard files; readiness was
+        # already achieved). lineage ({"chunks": [digest, ...]}, ISSUE 20)
+        # is appended to the ledger for EVERY report — a late duplicate's
+        # chunk list is the re-execution-equality evidence mrcheck
+        # replays, so it must land beside the winner's, not be dropped.
+        first = tid not in self.map.reported
+        if part_bytes is not None and first:
             self.report.record_partition_ready(tid, part_bytes)
             self._record_readiness(tid, part_bytes, wid=wid)
+        if lineage is not None and 0 <= tid < self.cfg.map_n:
+            chunks = self._valid_chunks(lineage)
+            if chunks is not None:
+                pb = list(part_bytes) if isinstance(
+                    part_bytes, (list, tuple)) else []
+                if first:
+                    self._lineage_chunks[tid] = chunks
+                    self._lineage_pb[tid] = pb
+                self._lineage_append({
+                    "t": "attempt", "phase": "map", "tid": tid,
+                    "attempt": attempt, "wid": wid,
+                    "chunks": chunks, "part_bytes": pb,
+                })
         done = self._finish(self.map, "map", tid, attempt, wid)
         log.info("map %d finished (phase done=%s)", tid, done)
         return done
 
     def report_reduce_task_finish(self, tid: int, attempt: int = 0,
                                   wid: int = -1) -> bool:
+        # Partition claim record (ISSUE 20), first report only: partition
+        # tid's contributing chunks = the union of every first-reported
+        # map attempt's chunks whose part_bytes vector shows bytes for
+        # this partition (a missing/short vector claims conservatively —
+        # over-approximation never hides a dependency), bytes = the summed
+        # intermediate contribution.
+        if self._lineage_chunks and tid not in self.reduce.reported \
+                and 0 <= tid < self.cfg.reduce_n:
+            claims: set = set()
+            rbytes = 0
+            for mtid, chunks in self._lineage_chunks.items():
+                pb = self._lineage_pb.get(mtid) or []
+                if tid < len(pb):
+                    if not pb[tid]:
+                        continue  # exact: zero bytes shipped to tid
+                    rbytes += int(pb[tid])
+                claims.update(chunks)
+            self._lineage_append({
+                "t": "part", "r": tid, "bytes": rbytes,
+                "chunks": sorted(claims),
+            })
         done = self._finish(self.reduce, "reduce", tid, attempt, wid)
         log.info("reduce %d finished (job done=%s)", tid, done)
         return done

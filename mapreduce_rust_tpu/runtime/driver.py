@@ -57,7 +57,12 @@ import numpy as np
 
 from mapreduce_rust_tpu.apps.base import App
 from mapreduce_rust_tpu.apps.word_count import WordCount
-from mapreduce_rust_tpu.config import Config, profile_forced, sync_dispatch_forced
+from mapreduce_rust_tpu.config import (
+    Config,
+    lineage_forced,
+    profile_forced,
+    sync_dispatch_forced,
+)
 from mapreduce_rust_tpu.core.kv import KVBatch
 from mapreduce_rust_tpu.ops.groupby import (
     clamp_batch,
@@ -622,6 +627,41 @@ def scan_keys(kind, parts) -> np.ndarray:
     return parts[2] if kind == "raw" else parts[1]
 
 
+def _routed_parts(keys, mask, reduce_n: int, range_mode: bool = False):
+    """Reduce partitions one chunk's (masked) keys route to — the
+    provenance ledger's chunk→partition edge (ISSUE 20). Hash apps route
+    k1 % reduce_n, so one vectorized unique over the scan's key column
+    answers it exactly; range apps route through sampler-derived
+    splitters on the WORD, which the scan result no longer carries — a
+    range chunk claims every partition (conservative: the blast radius
+    can only over-approximate, never miss a dependent partition)."""
+    if range_mode:
+        return list(range(reduce_n))
+    k1 = keys[:, 0] if getattr(keys, "ndim", 1) > 1 else keys
+    if mask is not None:
+        k1 = k1[mask]
+    n = len(k1)
+    if n == 0:
+        return []
+    # Exact answer, sampled fast path: a strided sample that already
+    # shows every partition proves the full set (an observed residue is
+    # definitely present; more than reduce_n is impossible) without
+    # touching the other keys — for any non-degenerate chunk with
+    # reduce_n in the single digits this is the ~always branch, and it
+    # keeps the ledger's per-byte tax inside the ≤2% bench contract.
+    # Only a skewed chunk that genuinely misses partitions pays the full
+    # bincount pass.
+    if n > 4096:
+        sample = np.asarray(k1[:: n // 2048], dtype=np.int64) % reduce_n
+        if len(np.unique(sample)) == reduce_n:
+            return list(range(reduce_n))
+    hits = np.bincount(
+        (np.asarray(k1, dtype=np.int64) % reduce_n).astype(np.intp),
+        minlength=reduce_n,
+    )
+    return [int(r) for r in np.flatnonzero(hits)]
+
+
 def fold_scan_into_dictionary(dictionary: Dictionary, mask, kind, parts) -> None:
     """Fold one tagged scan result — ("raw", raw, ends, keys[, ...]) or
     ("list", words, keys[, ...]) — into the egress dictionary, restricted
@@ -687,13 +727,20 @@ class _IngestStream:
                  dictionary: Dictionary, doc_id_offset: int = 0,
                  skip_chunks: int = 0,
                  doc_ids: "Sequence[int] | None" = None,
-                 host_mask=None) -> None:
+                 host_mask=None, lineage_range: bool = False) -> None:
         import queue
         import threading
         from concurrent.futures import ThreadPoolExecutor
 
+        from mapreduce_rust_tpu.runtime.lineage import active_ledger
+
         self.cfg = cfg
         self.stats = stats
+        # Provenance (ISSUE 20): digests computed on the scan pool (the
+        # payload is hot there), recorded in chunk order by _fold_done on
+        # the consumer thread. None when the ledger is off — zero work.
+        self._ledger = active_ledger()
+        self._lineage_range = lineage_range
         # Chunks below a resumed checkpoint: read (the chunker must stay
         # positionally deterministic) but neither dictionary-scanned nor
         # yielded — their words and counts are already in the checkpoint.
@@ -747,14 +794,35 @@ class _IngestStream:
         finally:
             self._put(_SENTINEL)
 
+    def _scan_lineage(self, payload: bytes):
+        """_scan_payload plus the chunk's content digest, both on the pool
+        thread where the payload is hot — the scan result grows a (dg,
+        nbytes) prefix that _fold_done strips and records in FIFO order."""
+        from mapreduce_rust_tpu.runtime.lineage import chunk_digest
+
+        return (chunk_digest(payload), len(payload), *_scan_payload(payload))
+
     def _fold_done(self, block: bool = False) -> None:
-        while self.scans and (block or self.scans[0].done()):
-            kind, *rest = self.scans.popleft().result()
-            mask = self.host_mask(scan_keys(kind, rest))
+        while self.scans and (block or self.scans[0][0].done()):
+            fut, doc_id = self.scans.popleft()
+            res = fut.result()
+            if self._ledger is not None:
+                dg, nb, kind, *rest = res
+            else:
+                kind, *rest = res
+            keys = scan_keys(kind, rest)
+            mask = self.host_mask(keys)
             fold_scan_into_dictionary(self.dictionary, mask, kind, rest)
+            if self._ledger is not None:
+                self._ledger.record_chunk(
+                    doc_id, nb, dg,
+                    parts=_routed_parts(keys, mask, self.cfg.reduce_n,
+                                        self._lineage_range),
+                )
             block = False  # blocking drain pops exactly one
 
     def __iter__(self):
+        scan = self._scan_lineage if self._ledger is not None else _scan_payload
         while True:
             t0 = time.perf_counter()
             with trace_span("ingest.wait"):
@@ -770,7 +838,8 @@ class _IngestStream:
                 self.skip_chunks -= 1
                 continue
             self.scans.append(
-                self.pool.submit(_scan_payload, bytes(chunk.data[: chunk.nbytes]))
+                (self.pool.submit(scan, bytes(chunk.data[: chunk.nbytes])),
+                 chunk.doc_id)
             )
             # Backpressure: each pending future pins a chunk-sized payload;
             # fold the oldest (blocking) once the backlog exceeds the pool.
@@ -789,7 +858,7 @@ class _IngestStream:
                     self.q.get_nowait()
             except Exception:
                 pass
-            for f in self.scans:
+            for f, _doc in self.scans:
                 f.cancel()
             self.scans.clear()
         else:
@@ -860,7 +929,8 @@ def _stream_single(cfg: Config, app: App, inputs, stats, acc, dictionary,
                 replay_chunk(chunk_host, did)
 
     ingest = _IngestStream(cfg, inputs, stats, dictionary, doc_id_offset,
-                           host_mask=app.host_mask)
+                           host_mask=app.host_mask,
+                           lineage_range=app.partition_mode == "range")
     try:
         for chunk in ingest:
             with trace_span("chunk.dispatch"):
@@ -1874,6 +1944,22 @@ def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
     # The dispatch plane (ISSUE 13) owns the device state, the pending
     # merges and their drain: the router below never books a device hop.
     dispatch = _DispatchPlane(cfg, app, stats, acc, dictionary, device)
+    # Provenance (ISSUE 20): digest each window on ITS scan thread (the
+    # bytes are hot there), record on the consumer — in window order, so
+    # the ledger is identical for any (workers, shards) combination.
+    from mapreduce_rust_tpu.runtime.lineage import active_ledger, chunk_digest
+
+    ledger = active_ledger()
+    lineage_range = app.partition_mode == "range"
+
+    def lineage_record(doc_id, lin, keys, mask) -> None:
+        if lin is None:
+            return
+        dg, nb = lin
+        ledger.record_chunk(
+            doc_id, nb, dg,
+            parts=_routed_parts(keys, mask, cfg.reduce_n, lineage_range),
+        )
 
     def scan_window(item):
         # PURE: reads its window, returns its result + its own duration.
@@ -1897,10 +1983,17 @@ def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
                     (doc_id, "raw", res) if res is not None
                     else (doc_id, "py", _py_scan_count(window))
                 )
-        return (*out, time.perf_counter() - t0)
+            # Digest AFTER the scan: the scan just faulted every window
+            # page in, so the sampled blake2b reads hot memory instead of
+            # paying the memmap's cold-page latency itself.
+            lin = (
+                (chunk_digest(window), int(window.size))
+                if ledger is not None else None
+            )
+        return (*out, lin, time.perf_counter() - t0)
 
     def consume(result) -> None:
-        doc_id, kind, res, scan_s = result
+        doc_id, kind, res, lin, scan_s = result
         stats.host_map_s += scan_s  # aggregate scan seconds across workers
         # Per-window scan distribution: a high-cardinality window shows up
         # as a p99 tail here long before it moves the aggregate (ISSUE 5).
@@ -1920,6 +2013,7 @@ def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
                 # permutation over and is done in O(1).
                 raw, ends, keys, counts, pos, shard_counts = res
                 mask = app.host_mask(keys)  # grouped rows; per-row exact
+                lineage_record(doc_id_offset + doc_id, lin, keys, mask)
                 fold.route_raw(raw, ends, keys, shard_counts, mask)
                 dispatch.submit(
                     (doc_id_offset + doc_id, "sharded", keys, counts, pos,
@@ -1928,6 +2022,7 @@ def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
             elif kind == "raw":
                 raw, ends, keys, counts = res
                 mask = app.host_mask(keys)
+                lineage_record(doc_id_offset + doc_id, lin, keys, mask)
                 fold_scan_into_dictionary(dictionary, mask, "raw", (raw, ends, keys))
                 dispatch.submit(
                     (doc_id_offset + doc_id, "flat", keys, counts, None,
@@ -1936,6 +2031,7 @@ def _stream_host_map(cfg: Config, app: App, inputs, stats, acc, dictionary,
             else:
                 words, keys, counts = res
                 mask = app.host_mask(keys)
+                lineage_record(doc_id_offset + doc_id, lin, keys, mask)
                 if fold is not None:
                     # Python-fallback scan has no pre-partitioning: the
                     # whole (read-only) result fans out and each shard
@@ -2198,6 +2294,7 @@ def _stream_multihost(cfg: Config, app: App, inputs, stats, acc, dictionary) -> 
     ingest = _IngestStream(
         cfg, [p for _i, p in my_inputs], stats, dictionary,
         doc_ids=[i for i, _p in my_inputs], host_mask=app.host_mask,
+        lineage_range=app.partition_mode == "range",
     )
 
     def to_global(local_np: np.ndarray, global_shape):
@@ -2499,6 +2596,10 @@ def _stream_sharded(cfg: Config, app: App, inputs, stats, acc, dictionary) -> No
             if p_n or b_n:
                 replay_group(row[5], row[6], p_n)
 
+    from mapreduce_rust_tpu.runtime.lineage import active_ledger, chunk_digest
+
+    ledger = active_ledger()
+    lineage_range = app.partition_mode == "range"
     for doc_id, window in _iter_windows(cfg, inputs, stats):
         stats.chunks += 1
         raw = bytes(window)
@@ -2506,9 +2607,16 @@ def _stream_sharded(cfg: Config, app: App, inputs, stats, acc, dictionary) -> No
         if norm is None:
             norm = normalize_unicode(raw)
         kind, *scan = _scan_payload(norm)
-        fold_scan_into_dictionary(
-            dictionary, app.host_mask(scan_keys(kind, scan)), kind, scan
-        )
+        keys = scan_keys(kind, scan)
+        mask = app.host_mask(keys)
+        fold_scan_into_dictionary(dictionary, mask, kind, scan)
+        if ledger is not None:
+            # Digest the RAW window (pre-normalization) — same bytes the
+            # other engines hash, so corpus digests agree across engines.
+            ledger.record_chunk(
+                doc_id, len(raw), chunk_digest(raw),
+                parts=_routed_parts(keys, mask, cfg.reduce_n, lineage_range),
+            )
         # Group seams are host-side cuts like window seams, so they align
         # to whitespace — a token split THERE would fragment into keys no
         # dictionary entry matches. The arbitrary (mid-word) cuts this
@@ -2697,7 +2805,8 @@ def _stream_mesh(cfg: Config, app: App, inputs, stats, acc, dictionary) -> None:
             drain(depth)
 
     ingest = _IngestStream(cfg, inputs, stats, dictionary, skip_chunks=skip_chunks,
-                           host_mask=app.host_mask)
+                           host_mask=app.host_mask,
+                           lineage_range=app.partition_mode == "range")
     try:
         for chunk in ingest:
             group_chunks.append(chunk.data)
@@ -2895,6 +3004,23 @@ def run_job(
         if tracer is not None:
             tracer.profiler = sprof  # partials keep the flamegraph
             sprof.tracer = tracer    # per-plane self-time counter tracks
+    # Provenance ledger (ISSUE 20): per-chunk content digests + partition
+    # routing recorded from the same consumer loops that tick the flight
+    # recorder. Observational only — outputs stay bit-identical ON vs
+    # OFF. Lands in the manifest as stats.lineage (build_manifest reads
+    # the still-active ledger) and in partials as body["lineage"].
+    ledger = None
+    if cfg.lineage or lineage_forced():
+        from mapreduce_rust_tpu.runtime.lineage import (
+            LEDGER_NAME,
+            start_ledger,
+        )
+
+        os.makedirs(cfg.work_dir, exist_ok=True)
+        ledger = start_ledger(os.path.join(cfg.work_dir, LEDGER_NAME),
+                              inputs=inputs, reduce_n=cfg.reduce_n)
+        if tracer is not None:
+            tracer.lineage = ledger  # partials keep the provenance tail
     output_files: list[str] = []
     table: dict = {}
 
@@ -2975,6 +3101,10 @@ def run_job(
                         # Per-partition output bytes: the reduce-side skew
                         # signal the doctor scores (index = partition r).
                         stats.partition_bytes.append(written)
+                        if ledger is not None:
+                            # Egress claim (ISSUE 20): partition r's bytes
+                            # + the chunks whose routed keys contributed.
+                            ledger.record_partition(r, written)
                         output_files.append(path)
 
         stats.wall_seconds = time.perf_counter() - t0
@@ -3018,6 +3148,14 @@ def run_job(
             # manifest serialization. The stopped profiler stays in the
             # global slot so build_manifest embeds its final aggregate.
             sprof.stop()
+        if ledger is not None:
+            # Seal the jsonl (end record: folded corpus content digest)
+            # before the flush; the closed ledger stays in the global
+            # slot so build_manifest embeds stats.lineage.
+            try:
+                ledger.close()
+            except Exception:
+                log.warning("lineage ledger close failed", exc_info=True)
         if tracer is not None:
             stop_tracing()
         if tracer is not None or cfg.manifest_path:
@@ -3070,6 +3208,11 @@ def run_job(
             from mapreduce_rust_tpu.runtime.prof import stop_profiler
 
             stop_profiler(sprof)
+        if ledger is not None:
+            # Same order and compare-and-clear discipline as the profiler.
+            from mapreduce_rust_tpu.runtime.lineage import stop_ledger
+
+            stop_ledger(ledger)
     return JobResult(stats=stats, table=table, output_files=output_files)
 
 
@@ -3087,6 +3230,10 @@ def _stream_finalize(cfg: Config, app: App, stats: JobStats, acc: HostAccumulato
     the in-RAM path for apps that override App.finalize.
     """
     import tempfile
+
+    from mapreduce_rust_tpu.runtime.lineage import active_ledger
+
+    ledger = active_ledger()
 
     with stats.phase("finalize"):
         rows = acc.fold_arrays()  # sorted by (k1, k2[, value])
@@ -3212,6 +3359,10 @@ def _stream_finalize(cfg: Config, app: App, stats: JobStats, acc: HostAccumulato
                 # Same reduce-skew signal as the in-RAM egress path (the
                 # joined buffer's length IS sum(len(line) + 1)).
                 stats.partition_bytes.append(len(buf))
+                if ledger is not None:
+                    # Egress claim (ISSUE 20), streaming tier: same
+                    # contract as the in-RAM path's record_partition.
+                    ledger.record_partition(r, len(buf))
                 if write_outputs:
                     path = os.path.join(cfg.output_dir, f"mr-{r}.txt")
                     with open(path, "wb") as f:

@@ -894,6 +894,21 @@ def build_manifest(cfg, stats=None, app_name: str | None = None,
                 stats_block["profile"] = p.profile_dict()
     except Exception:
         pass  # telemetry stays best-effort
+    # Provenance ledger (ISSUE 20) — same pattern: whatever ledger is
+    # active in THIS process lands as stats.lineage (counts + folded
+    # corpus digests + the jsonl path), read back by the jax-free
+    # `lineage` subcommand and the doctor's incremental-opportunity
+    # finding. Summary only: the per-chunk records stay in the jsonl.
+    try:
+        from mapreduce_rust_tpu.runtime.lineage import active_ledger
+
+        led = active_ledger()
+        if led is not None:
+            stats_block = m.setdefault("stats", {})
+            if "lineage" not in stats_block:
+                stats_block["lineage"] = led.lineage_dict()
+    except Exception:
+        pass  # telemetry stays best-effort
     return m
 
 

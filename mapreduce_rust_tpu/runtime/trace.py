@@ -113,6 +113,9 @@ class Tracer:
         self.profiler = None            # live SamplingProfiler (ISSUE 19):
         # same contract — partials embed the live profile snapshot, so a
         # SIGKILLed run keeps its flamegraph alongside its events
+        self.lineage = None             # live LineageLedger (ISSUE 20):
+        # same contract — partials embed the provenance tail, so a
+        # SIGKILLed run's backward queries still resolve
         # Flight recorder state (see enable_flight_recorder).
         self._snap_path: "str | None" = None
         self._snap_period = 5.0
@@ -312,6 +315,16 @@ class Tracer:
                     # SIGKILLed run's sample aggregate would otherwise die
                     # with the process before any manifest flush.
                     body["profile"] = sprof.profile_dict()
+                except Exception:
+                    pass  # the recorder must never fail the run
+            ledger = self.lineage
+            if ledger is not None:
+                try:
+                    # Provenance rides the partial (ISSUE 20): the jsonl
+                    # on disk survives a SIGKILL by itself, but the
+                    # embedded tail lets the lineage CLI answer queries
+                    # from the partial alone.
+                    body["lineage"] = ledger.tail_dict()
                 except Exception:
                     pass  # the recorder must never fail the run
             d = os.path.dirname(os.path.abspath(path))

@@ -113,34 +113,28 @@ _CONFIG_KEYS = ("app", "app_args", "reduce_n", "input_pattern",
 
 def scan_corpus(input_dir: str, pattern: str) -> tuple:
     """ONE listing pass over a job's corpus: (sorted paths, total bytes,
-    digest). The digest is the (name, size, mtime) fingerprint the
-    per-job coordinator journal header uses, so "same corpus" means the
-    same thing to the cache and to resume. Submission validation, the
-    cache key and the admission byte count all reuse a single call —
-    the submit handler runs ON the event loop, and its cost must be
-    bounded by one directory scan, not three (blocking-in-async
-    doctrine)."""
+    digest). The digest is runtime.lineage.corpus_fingerprint — the same
+    (name, size, mtime) formula the per-job coordinator journal header
+    and the lineage ledger header use (ISSUE 20's one-digest-seam
+    contract), so "same corpus" means the same thing to the cache, to
+    resume and to provenance; _finalize_job cross-checks the submit-time
+    value against the ledger's copy. Submission validation, the cache
+    key and the admission byte count all reuse a single call — the
+    submit handler runs ON the event loop, and its cost must be bounded
+    by one directory scan, not three (blocking-in-async doctrine)."""
     import glob
 
-    sig = hashlib.sha256()
-    total = 0
+    from mapreduce_rust_tpu.runtime.lineage import corpus_fingerprint
+
     if not input_dir or not os.path.isdir(input_dir):
         # A missing/empty dir must not glob relative to the service's
         # CWD (os.path.join("", "*.txt") == "*.txt") — the submit
         # handler runs on the event loop and a malformed spec must cost
         # O(1), not a directory scan of wherever the service started.
-        return [], 0, sig.hexdigest()[:16]
+        return [], 0, hashlib.sha256().hexdigest()[:16]
     paths = sorted(glob.glob(os.path.join(input_dir, pattern)))
-    for p in paths:
-        try:
-            st = os.stat(p)
-            total += st.st_size
-            sig.update(
-                f"{os.path.basename(p)}:{st.st_size}:{st.st_mtime_ns};".encode()
-            )
-        except OSError:
-            sig.update(f"{os.path.basename(p)}:gone;".encode())
-    return paths, total, sig.hexdigest()[:16]
+    dg, total = corpus_fingerprint(paths)
+    return paths, total, dg
 
 
 def spec_corpora(spec: dict) -> list:
@@ -378,6 +372,12 @@ class Job:
     # outputs) when the twin does, and re-queues for real computation if
     # the twin fails or is cancelled.
     bytes_in: int = 0
+    # Submit-time corpus digest (runtime.lineage.corpus_fingerprint over
+    # the job's listing — the result-cache key's corpus half). On
+    # lineage-enabled runs _finalize_job cross-checks it against the
+    # ledger header: the cache key and the provenance plane must name the
+    # same corpus, or the cache is keyed on bytes nobody scanned.
+    corpus_digest: str = ""
     grants: int = 0              # tenant attribution: task grants served
     task_seconds: float = 0.0    # Σ attempt durations (final snapshot)
     submitted_s: float = 0.0     # service-uptime stamps
@@ -479,6 +479,11 @@ class JobService:
         self._fleet_bubble_ws = 0.0   # idle ∩ (queued job | map barrier)
         self._fleet_active_ws = 0.0   # registered-and-not-drained w-s
         self.jobs_completed = 0
+        # Provenance cross-check failures (ISSUE 20): done jobs whose
+        # lineage ledger header disagrees with the submit-time corpus
+        # digest the result-cache key was minted from. Nonzero means the
+        # cache could serve outputs for a corpus that changed mid-run.
+        self.lineage_mismatches = 0
         self.cache = _ResultCache(cfg.service_cache_entries)
         self._pending_io: list = []  # executor futures (job-report
         # writes) the serve teardown must reap before the manifest flush;
@@ -605,7 +610,8 @@ class JobService:
 
     def _enqueue(self, jid: str, spec: dict, priority: int,
                  nbytes: "int | None" = None,
-                 cache_key: "str | None" = None) -> Job:
+                 cache_key: "str | None" = None,
+                 digest: str = "") -> Job:
         if nbytes is None or cache_key is None:
             # Replay/direct callers arrive without a scan; submit_job
             # threads its single pass through. scan_corpus_spec, not
@@ -617,7 +623,7 @@ class JobService:
         job = Job(jid=jid, spec=spec, priority=priority,
                   seq=next(self._seq), bytes_in=nbytes,
                   submitted_s=self.report.uptime_s(),
-                  cache_key=cache_key)
+                  cache_key=cache_key, corpus_digest=digest)
         self.jobs[jid] = job
         heapq.heappush(self._queue, (-priority, job.seq, jid))
         return job
@@ -700,7 +706,7 @@ class JobService:
             return {"ok": True, "job": jid, "state": "joined",
                     "cached": False, "joined": twin.jid}
         job = self._enqueue(jid, spec, priority, nbytes=nbytes,
-                            cache_key=key)
+                            cache_key=key, digest=digest)
         self._journal("submit", jid, spec=spec, priority=priority)
         log.info("job %s: queued (%s, %.1f MB, priority %d)", jid,
                  spec["app"], job.bytes_in / (1 << 20), priority)
@@ -1298,17 +1304,20 @@ class JobService:
 
     def report_map_task_finish(self, tid: int, attempt: int = 0,
                                wid: int = -1, job=None,
-                               part_bytes=None) -> bool:
+                               part_bytes=None, lineage=None) -> bool:
         # ``part_bytes`` is the trailing-default per-partition
         # intermediate-bytes vector (ISSUE 16) — forwarded to the job's
-        # coordinator, which folds it into partition readiness. Old
-        # 3/4-positional clients stay wire-valid.
+        # coordinator, which folds it into partition readiness; ``lineage``
+        # (ISSUE 20) is the attempt's chunk-digest payload, forwarded the
+        # same way into the job's lineage.jsonl. Old 3/4/5-positional
+        # clients stay wire-valid.
         j = self._route(job)
         self._fleet_release(wid)
         if j is None:
             return True  # job already closed: the report is moot
         done = j.coord.report_map_task_finish(tid, attempt=attempt, wid=wid,
-                                              part_bytes=part_bytes)
+                                              part_bytes=part_bytes,
+                                              lineage=lineage)
         return done
 
     def report_reduce_task_finish(self, tid: int, attempt: int = 0,
@@ -1382,6 +1391,7 @@ class JobService:
             job.outputs = sorted(glob.glob(
                 os.path.join(job.cfg.output_dir, "mr-*.txt")
             ))
+            self._lineage_crosscheck(job)
             self.cache.put(job.cache_key, {
                 "job": job.jid, "outputs": list(job.outputs),
             })
@@ -1419,6 +1429,41 @@ class JobService:
                 self.registry.gauge(name).remove_labels(job=job.jid)
         self._admit_tick()
 
+    def _lineage_crosscheck(self, job: Job) -> None:
+        """Result-cache ↔ provenance agreement (ISSUE 20 satellite): on a
+        lineage-enabled done job, the ledger header's corpus fingerprint
+        — written by the coordinator from the SAME corpus_fingerprint
+        seam the cache key's digest came from — must equal the
+        submit-time digest, and the ledger's byte count must equal the
+        admission scan's. A mismatch means the corpus changed between
+        submit and scan: the result-cache entry being minted right after
+        this would serve THOSE outputs for a key naming DIFFERENT bytes.
+        Single-corpus specs only (the multi-corpus digest combines
+        per-corpus digests under their names — not the ledger's flat
+        listing); best-effort, the finalize must never fail on it."""
+        if not job.corpus_digest or job.cfg is None \
+                or job.spec.get("inputs"):
+            return
+        path = os.path.join(job.cfg.work_dir, "lineage.jsonl")
+        try:
+            with open(path) as f:
+                hdr = json.loads(f.readline())
+        except (OSError, ValueError):
+            return  # no ledger (lineage off) or torn header — nothing to check
+        if hdr.get("t") != "start":
+            return
+        ok_dg = hdr.get("corpus_meta_digest") == job.corpus_digest
+        ok_bytes = hdr.get("corpus_bytes") == job.bytes_in
+        if not (ok_dg and ok_bytes):
+            self.lineage_mismatches += 1
+            log.error(
+                "job %s: lineage/cache corpus disagreement — ledger "
+                "%s/%sB vs submit %s/%sB (corpus changed between submit "
+                "and scan; cache entry is suspect)",
+                job.jid, hdr.get("corpus_meta_digest"),
+                hdr.get("corpus_bytes"), job.corpus_digest, job.bytes_in,
+            )
+
     # ---- observability RPCs + ticks ----
 
     def service_summary(self) -> dict:
@@ -1437,6 +1482,7 @@ class JobService:
             "budget_bytes": self.budget_bytes(),
             "max_jobs": self.cfg.service_max_jobs,
             "admission_blocked": self.admission_blocked,
+            "lineage_mismatches": self.lineage_mismatches,
             "cache": self.cache.stats(),
             "queue_wait_s": self._queue_wait_hist.to_dict(),
             "job_wall_s": self._job_wall_hist.to_dict(),

@@ -166,6 +166,16 @@ class Worker:
         # bit-identical either way).
         self._part_bytes: dict[int, list] = {}
         self._fleet_enabled = os.environ.get("MR_FLEET", "1") != "0"
+        # Provenance (ISSUE 20): per-map-task chunk content digests,
+        # stashed like _part_bytes and shipped as one more trailing
+        # default field on the finish report — the coordinator appends
+        # them to {work}/lineage.jsonl as attempt records. Opt-in
+        # (Config.lineage / MR_LINEAGE); observational only.
+        from mapreduce_rust_tpu.runtime.lineage import lineage_forced
+
+        self._task_chunks: dict[int, list] = {}
+        self._lineage_on = cfg.lineage or lineage_forced()
+        self._scan_digests: list = []  # executor thread, reset per task
 
     def _metrics_tick(self) -> None:
         """Sampler tick on this worker's own registry (the global
@@ -260,18 +270,30 @@ class Worker:
 
         dictionary = new_dictionary(self.cfg)
         op = self.app.combine_op
+        self._scan_digests = []  # fresh provenance per task (ISSUE 20)
         if self.engine == "device":
+            # Device-engine tasks ship no chunk list: windows stream
+            # through _IngestStream, whose recorder is the driver-side
+            # process-global ledger (absent in a worker process).
             return self._map_table_device(doc_id, path, dictionary)
         if op in ("sum", "distinct"):
             fast = self._map_table_host_native(doc_id, path, dictionary)
             if fast is not None:
                 return fast, dictionary
+        # A native pass that bailed mid-file recorded partial windows;
+        # the fallback re-reads from byte 0, so restart the digest list.
+        self._scan_digests = []
         # Fallback (no native lib, or an op the fused scan doesn't model):
         # the reference's exact per-task work (wc::map + combiner) in Python.
         counts: collections.Counter = collections.Counter()
         with open(path, "rb") as f:
             for chunk in chunk_stream(f, doc_id, self.cfg.chunk_bytes):
-                words = extract_words(bytes(chunk.data[: chunk.nbytes]))
+                payload = bytes(chunk.data[: chunk.nbytes])
+                if self._lineage_on:
+                    from mapreduce_rust_tpu.runtime.lineage import chunk_digest
+
+                    self._scan_digests.append(chunk_digest(payload))
+                words = extract_words(payload)
                 counts.update(words)
         table: dict = {}
         uniq = list(counts.keys())
@@ -307,6 +329,14 @@ class Worker:
         from mapreduce_rust_tpu.runtime.driver import fold_scan_into_dictionary
 
         for _doc, window in _iter_windows(self.cfg, [path], JobStats()):
+            if self._lineage_on:
+                # Same raw-window digest the driver's host-map engine
+                # records — a re-executed attempt (same cfg, same file)
+                # must produce the identical chunk list, which is what
+                # mrcheck's lineage-conservation equality checks.
+                from mapreduce_rust_tpu.runtime.lineage import chunk_digest
+
+                self._scan_digests.append(chunk_digest(window))
             res = scan_count_raw(window)
             if res is None:
                 return None
@@ -464,6 +494,8 @@ class Worker:
             )
         if self._fleet_enabled:
             self._part_bytes[tid] = part_bytes
+        if self._lineage_on and self._scan_digests:
+            self._task_chunks[tid] = list(self._scan_digests)
         # Dictionary shards are partitioned by the same app route as the
         # spills, so reduce task r reads exactly its own words —
         # mirroring the mr-{m}-{r} protocol (src/mr/worker.rs:121).
@@ -853,7 +885,15 @@ class Worker:
             params = [tid, self._attempts.get((phase, tid), 0), self._wid]
             part_bytes = self._part_bytes.pop(tid, None) \
                 if phase == "map" else None
-            if part_bytes is not None:
+            lineage = self._task_chunks.pop(tid, None) \
+                if phase == "map" else None
+            if lineage is not None:
+                # One more trailing default after part_bytes (ISSUE 20):
+                # the attempt's chunk digests, appended by the
+                # coordinator to the job's lineage.jsonl. part_bytes
+                # must fill its slot (possibly None — MR_FLEET=0).
+                params.extend([job, part_bytes, {"chunks": lineage}])
+            elif part_bytes is not None:
                 # Trailing default fields, wid/sample-style: old servers
                 # never see them, old clients stay wire-valid. ``job``
                 # must fill its slot (possibly None) so part_bytes lands

@@ -326,7 +326,7 @@ def test_mesh_driver_kill_and_resume_exact(tmp_path):
                      device="cpu",
                      trace_path={str(tmp_path / "trace.json")!r},
                      flight_record_period_s=1e-6,
-                     profile=True, profile_hz=200.0)
+                     profile=True, profile_hz=200.0, lineage=True)
         drv.run_job(cfg, [{paths[0]!r}], write_outputs=False)
         print("CHILD_FINISHED")
     """)
@@ -374,6 +374,20 @@ def test_mesh_driver_kill_and_resume_exact(tmp_path):
     for line in open(_Args.folded).read().splitlines():
         stack, count = line.rsplit(" ", 1)
         assert int(count) > 0 and all(stack.split(";"))
+
+    # The partial also embeds the lineage tail (ISSUE 20): a SIGKILLed
+    # run keeps its provenance, the on-disk ledger parses torn-tail-safe,
+    # and backward queries still resolve — from the partial AND the jsonl.
+    from mapreduce_rust_tpu.analysis import lineage as _al
+
+    lin = snap.get("lineage")
+    assert lin and lin["records"], "partial lost the lineage tail"
+    for target in (str(partial), str(work / "lineage.jsonl")):
+        led = _al.load_ledger(target)
+        assert led["chunks"], f"{target}: no chunk records survived"
+        resolved = [r for r in range(4)
+                    if _al.backward(led, r)["chunks"]]
+        assert resolved, f"{target}: backward queries resolved empty"
 
     # Resume in-process from the journaled checkpoint; counts must be exact.
     cfg = small_cfg(tmp_path, chunk_bytes=4096, mesh_shape=4, resume=True,

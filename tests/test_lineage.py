@@ -1,0 +1,403 @@
+"""mrlineage (ISSUE 20): the provenance ledger's contracts.
+
+Ledger units (write → parse, fold determinism, torn-tail safety), digest
+stability across the (host_map_workers, fold_shards) matrix with
+bit-identical outputs lineage ON vs OFF, the lineage-conservation
+invariant (clean run passes mrcheck, a mutated claim fires exactly the
+new code), blast-radius diff exactness on synthetic edits, backward
+queries resolving digests that match the input bytes, and the jax-free
+CLI gate (the prof/check/doctor tooling doctrine).
+"""
+
+import json
+import os
+import pathlib
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from mapreduce_rust_tpu.analysis import lineage as al
+from mapreduce_rust_tpu.analysis import mrcheck
+from mapreduce_rust_tpu.config import Config
+from mapreduce_rust_tpu.runtime.lineage import (
+    FULL_DIGEST_MAX,
+    LEDGER_NAME,
+    LineageLedger,
+    chunk_digest,
+    corpus_fingerprint,
+    fold_digests,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TEXT = (
+    "the quick brown fox jumps over the lazy dog near the riverbank "
+    "while seventeen noisy magpies argue about provenance and blame\n"
+) * 200
+
+
+def write_inputs(tmp_path, texts):
+    paths = []
+    for i, t in enumerate(texts):
+        p = tmp_path / f"doc-{i}.txt"
+        p.write_bytes(t if isinstance(t, bytes) else t.encode())
+        paths.append(str(p))
+    return paths
+
+
+# ---------------------------------------------------------------------------
+# ledger units
+# ---------------------------------------------------------------------------
+
+def test_ledger_roundtrip_and_end_record(tmp_path):
+    inputs = write_inputs(tmp_path, [TEXT])
+    path = str(tmp_path / LEDGER_NAME)
+    led = LineageLedger(path, inputs=inputs, reduce_n=2)
+    d0 = chunk_digest(b"alpha " * 100)
+    d1 = chunk_digest(b"beta " * 100)
+    assert led.record_chunk(0, 600, d0, parts=[0]) == 0
+    assert led.record_chunk(1, 500, d1, parts=[0, 1]) == 1
+    led.record_partition(0, 123)
+    led.record_partition(1, 45)
+    led.close()
+    led.close()  # idempotent
+
+    doc = al.load_ledger(path)
+    assert [c["dg"] for c in doc["chunks"]] == [d0, d1]
+    assert doc["header"]["reduce_n"] == 2
+    assert doc["header"]["corpus_bytes"] == len(TEXT.encode())
+    # partition 0 claims both chunks, partition 1 only the routed one
+    claims = {p["r"]: p["chunks"] for p in doc["parts"]}
+    assert claims[0] == [d0, d1]
+    assert claims[1] == [d1]
+    end = doc["end"]
+    assert end["chunks"] == 2 and end["bytes"] == 1100
+    assert end["corpus_digest"] == fold_digests([d0, d1])
+    assert end["partition_bytes"] == [123, 45]
+
+
+def test_fold_is_ordered(tmp_path):
+    a, b = chunk_digest(b"a"), chunk_digest(b"b")
+    assert fold_digests([a, b]) != fold_digests([b, a])
+
+
+def test_torn_tail_is_popped(tmp_path):
+    path = tmp_path / LEDGER_NAME
+    led = LineageLedger(str(path), inputs=(), reduce_n=1)
+    led.record_chunk(0, 10, chunk_digest(b"x"), parts=[0])
+    led.close()
+    # SIGKILL mid-write: an unterminated trailing line must not poison
+    # the parse — the reader distrusts it, like the coordinator journal.
+    with open(path, "a") as f:
+        f.write('{"t":"chunk","seq":1,"doc":1,"by')
+    doc = al.load_ledger(str(path))
+    assert len(doc["chunks"]) == 1
+    assert doc["partial"] is True
+
+
+def test_sampled_digest_tiers():
+    small = b"s" * 1000
+    assert chunk_digest(small) == chunk_digest(bytearray(small))
+    big = os.urandom(FULL_DIGEST_MAX + (64 << 10))
+    dg = chunk_digest(big)
+    assert dg == chunk_digest(big)  # deterministic
+    # Appends and edge edits always move the sampled digest.
+    assert chunk_digest(big + b"tail") != dg
+    assert chunk_digest(b"head" + big[4:]) != dg
+
+
+def test_corpus_fingerprint_tracks_metadata(tmp_path):
+    p = tmp_path / "c.txt"
+    p.write_bytes(b"x" * 100)
+    dg1, total1 = corpus_fingerprint([str(p)])
+    assert total1 == 100
+    assert corpus_fingerprint([str(p)]) == (dg1, total1)
+    p.write_bytes(b"y" * 101)
+    dg2, total2 = corpus_fingerprint([str(p)])
+    assert (dg2, total2) != (dg1, total1) and total2 == 101
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: stability across the (workers, shards) matrix + ON/OFF
+# bit-identity
+# ---------------------------------------------------------------------------
+
+def _run(tmp_path, tag, lineage, workers=None, shards=None):
+    from mapreduce_rust_tpu.runtime.driver import run_job
+
+    inputs = write_inputs(tmp_path, [TEXT, TEXT[: len(TEXT) // 3]])
+    cfg = Config(
+        map_engine="host",
+        host_window_bytes=1 << 16,
+        host_map_workers=workers,
+        fold_shards=shards,
+        chunk_bytes=1 << 14,
+        merge_capacity=1 << 14,
+        reduce_n=4,
+        lineage=lineage,
+        work_dir=str(tmp_path / f"work-{tag}"),
+        output_dir=str(tmp_path / f"out-{tag}"),
+        device="cpu",
+    )
+    run_job(cfg, inputs)
+    outputs = {
+        p.name: p.read_bytes()
+        for p in sorted(pathlib.Path(cfg.output_dir).glob("mr-*.txt"))
+    }
+    return cfg, outputs
+
+
+def test_digest_stable_across_matrix_and_outputs_identical(tmp_path):
+    runs = {}
+    for tag, (w, s, lin) in {
+        "w1s1": (1, 1, True),
+        "w2s2": (2, 2, True),
+        "off": (1, 1, False),
+    }.items():
+        cfg, outputs = _run(tmp_path, tag, lin, workers=w, shards=s)
+        runs[tag] = (cfg, outputs)
+    # Outputs bit-identical lineage ON vs OFF (observational plane).
+    assert runs["w1s1"][1] == runs["off"][1]
+    assert runs["w1s1"][1]  # non-empty
+    # corpus_digest is a pure function of (bytes, window policy):
+    # identical whatever the worker/shard parallelism.
+    ends = {}
+    for tag in ("w1s1", "w2s2"):
+        doc = al.load_ledger(runs[tag][0].work_dir)
+        ends[tag] = doc["end"]["corpus_digest"]
+        assert doc["chunks"], tag
+    assert ends["w1s1"] == ends["w2s2"]
+    # OFF leaves no ledger behind.
+    assert not os.path.exists(
+        os.path.join(runs["off"][0].work_dir, LEDGER_NAME))
+
+
+def test_backward_digests_match_input_bytes(tmp_path):
+    # One window per file (window >> file): each ledger digest must
+    # reproduce from the raw input bytes — provenance that can be
+    # re-verified against the corpus, not just self-consistent.
+    from mapreduce_rust_tpu.runtime.driver import run_job
+
+    texts = [TEXT, TEXT[: len(TEXT) // 2] + "coda coda\n"]
+    inputs = write_inputs(tmp_path, texts)
+    cfg = Config(
+        map_engine="host",
+        host_window_bytes=16 << 20,
+        chunk_bytes=1 << 14,
+        merge_capacity=1 << 14,
+        reduce_n=4,
+        lineage=True,
+        work_dir=str(tmp_path / "work"),
+        output_dir=str(tmp_path / "out"),
+        device="cpu",
+    )
+    run_job(cfg, inputs)
+    doc = al.load_ledger(cfg.work_dir)
+    want = {chunk_digest(open(p, "rb").read()) for p in inputs}
+    assert {c["dg"] for c in doc["chunks"]} == want
+    for r in range(cfg.reduce_n):
+        res = al.backward(doc, r)
+        assert res["chunks"], f"partition {r} resolved empty"
+        assert {c["dg"] for c in res["chunks"]} <= want
+
+
+def test_manifest_carries_lineage_summary(tmp_path):
+    from mapreduce_rust_tpu.runtime.driver import run_job
+
+    inputs = write_inputs(tmp_path, [TEXT])
+    cfg = Config(
+        map_engine="host",
+        host_window_bytes=1 << 16,
+        chunk_bytes=1 << 14,
+        merge_capacity=1 << 14,
+        reduce_n=2,
+        lineage=True,
+        work_dir=str(tmp_path / "work"),
+        output_dir=str(tmp_path / "out"),
+        manifest_path=str(tmp_path / "manifest.json"),
+        device="cpu",
+    )
+    run_job(cfg, inputs)
+    stats = json.loads(
+        (tmp_path / "manifest.json").read_text())["stats"]
+    lin = stats["lineage"]
+    doc = al.load_ledger(cfg.work_dir)
+    assert lin["chunks"] == len(doc["chunks"]) > 0
+    assert lin["corpus_digest"] == doc["end"]["corpus_digest"]
+
+
+# ---------------------------------------------------------------------------
+# conservation invariant (mrcheck)
+# ---------------------------------------------------------------------------
+
+def _cluster_with_lineage(tmp_path):
+    """Fault-free in-process cluster with lineage on: real Workers ship
+    digest lists on their finish reports, the real Coordinator appends
+    attempt + part records — the artifacts mrcheck's pass replays."""
+    import asyncio
+
+    from test_control_plane import (
+        TEXTS,
+        _run_cluster,
+        make_cfg,
+        write_corpus,
+    )
+
+    write_corpus(tmp_path)
+    cfg = make_cfg(tmp_path, len(TEXTS), worker_n=2, lineage=True)
+    asyncio.run(_run_cluster(cfg, 2))
+    assert os.path.exists(os.path.join(cfg.work_dir, LEDGER_NAME))
+    return cfg
+
+
+def test_clean_cluster_run_passes_conservation(tmp_path):
+    cfg = _cluster_with_lineage(tmp_path)
+    doc = mrcheck.run_check(cfg.work_dir)
+    assert doc["ok"], doc["violations"]
+    assert doc["checked"].get("lineage_records", 0) > 0
+    # Backward queries resolve non-empty on the cluster ledger too.
+    led = al.load_ledger(cfg.work_dir)
+    res = al.backward(led, 0)
+    assert res["chunks"] or res["attempts"]
+
+
+def test_mutated_claim_fires_exactly_conservation(tmp_path):
+    cfg = _cluster_with_lineage(tmp_path)
+    dst = tmp_path / "mutated"
+    shutil.copytree(cfg.work_dir, dst)
+    assert mrcheck.mutate_lineage_conservation(str(dst)) == \
+        "lineage-conservation"
+    doc = mrcheck.run_check(str(dst))
+    assert {v["code"] for v in doc["violations"]} == \
+        {"lineage-conservation"}
+
+
+def test_reexecution_inequality_fires(tmp_path):
+    # Cluster-shape ledger: a re-executed attempt whose chunk list
+    # differs from its expired predecessor's is nondeterministic
+    # re-ingest — the second half of the invariant.
+    path = tmp_path / LEDGER_NAME
+    dg = chunk_digest(b"w0")
+    rows = [
+        {"t": "start", "schema": 1, "corpus_meta_digest": "0" * 16,
+         "corpus_bytes": 2, "reduce_n": 1, "inputs": ["a"], "pid": 1},
+        {"t": "attempt", "phase": "map", "tid": 0, "attempt": 0,
+         "wid": 1, "chunks": [dg], "part_bytes": [2]},
+        {"t": "attempt", "phase": "map", "tid": 0, "attempt": 1,
+         "wid": 2, "chunks": [chunk_digest(b"DIFFERENT")],
+         "part_bytes": [2]},
+    ]
+    path.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    violations = mrcheck.check_lineage(al.load_ledger(str(path)))
+    assert any(v.code == "lineage-conservation" for v in violations)
+
+
+# ---------------------------------------------------------------------------
+# diff / blast radius exactness
+# ---------------------------------------------------------------------------
+
+def _synth_ledger(path, chunks, parts_map, reduce_n=4):
+    """chunks: list of (doc, nbytes, dg, parts)."""
+    rows = [{"t": "start", "schema": 1, "corpus_meta_digest": "0" * 16,
+             "corpus_bytes": sum(c[1] for c in chunks),
+             "reduce_n": reduce_n, "inputs": ["x"], "pid": 1}]
+    for seq, (doc, nb, dg, ps) in enumerate(chunks):
+        rows.append({"t": "chunk", "seq": seq, "doc": doc, "bytes": nb,
+                     "dg": dg, "parts": ps})
+    for r, claim in parts_map.items():
+        rows.append({"t": "part", "r": r, "bytes": 1, "chunks": claim})
+    pathlib.Path(path).write_text(
+        "".join(json.dumps(r) + "\n" for r in rows))
+
+
+def test_diff_exact_on_synthetic_edit(tmp_path):
+    a, b, c, d = (chunk_digest(s) for s in
+                  (b"aa", b"bb", b"cc", b"dd"))
+    old = tmp_path / "old.jsonl"
+    new = tmp_path / "new.jsonl"
+    # old: chunks a(100B→p0), b(300B→p1); new: a kept, b edited→c(300B,
+    # p1), d appended (100B→p2). Hit bytes: 100 of 500 new bytes... no:
+    # memo-hit = unchanged-chunk bytes / new total = 100/500.
+    _synth_ledger(old, [(0, 100, a, [0]), (1, 300, b, [1])],
+                  {0: [a], 1: [b]})
+    _synth_ledger(new, [(0, 100, a, [0]), (1, 300, c, [1]),
+                        (2, 100, d, [2])], {0: [a], 1: [c], 2: [d]})
+    res = al.diff(al.load_ledger(str(old)), al.load_ledger(str(new)))
+    assert res["changed_chunks"] == 2          # c and d are new digests
+    assert res["removed_chunks"] == 1          # b gone
+    assert res["memo_hit_frac"] == pytest.approx(100 / 500)
+    assert sorted(res["affected_partitions"]) == [1, 2]
+    assert res["affected_partition_frac"] == pytest.approx(2 / 4)
+
+
+def test_diff_identical_corpora_is_full_hit(tmp_path):
+    a = chunk_digest(b"same")
+    led = tmp_path / "l.jsonl"
+    _synth_ledger(led, [(0, 50, a, [0])], {0: [a]})
+    doc = al.load_ledger(str(led))
+    res = al.diff(doc, doc)
+    assert res["memo_hit_frac"] == 1.0
+    assert res["changed_chunks"] == 0
+    assert res["affected_partitions"] == []
+
+
+def test_grown_corpus_memo_hit(tmp_path):
+    # The ROADMAP item 4 shape in miniature: +1 small appended file.
+    # memo_hit_frac must price exactly the old bytes / new total.
+    base = [(i, 1000, chunk_digest(str(i).encode()), [i % 4])
+            for i in range(20)]
+    extra = (20, 200, chunk_digest(b"new-file"), [3])
+    old = tmp_path / "old.jsonl"
+    new = tmp_path / "new.jsonl"
+    _synth_ledger(old, base, {})
+    _synth_ledger(new, base + [extra], {})
+    res = al.diff(al.load_ledger(str(old)), al.load_ledger(str(new)))
+    assert res["memo_hit_frac"] == pytest.approx(20000 / 20200)
+    assert res["memo_hit_frac"] >= 0.95
+    assert res["affected_partitions"] == [3]
+
+
+# ---------------------------------------------------------------------------
+# jax-free CLI gate
+# ---------------------------------------------------------------------------
+
+def run_gated(argv, timeout=60):
+    """Run `main(argv)` in a clean subprocess; exit 3 if jax snuck in."""
+    code = ("import sys; from mapreduce_rust_tpu.__main__ import main; "
+            f"rc = main({argv!r}); "
+            "sys.exit(rc if rc else (3 if 'jax' in sys.modules else 0))")
+    return subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=timeout,
+        env={"PYTHONPATH": REPO, "PATH": "/usr/bin:/bin"}, cwd=REPO,
+    )
+
+
+def test_lineage_cli_is_backend_free(tmp_path):
+    a, b = chunk_digest(b"one"), chunk_digest(b"two")
+    led = tmp_path / "l.jsonl"
+    _synth_ledger(led, [(0, 10, a, [0]), (1, 20, b, [1])],
+                  {0: [a], 1: [b]}, reduce_n=2)
+    r = run_gated(["lineage", str(led)])
+    assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+    assert "2 chunks" in r.stdout or "chunks" in r.stdout
+
+    r = run_gated(["lineage", str(led), "--backward", "1",
+                   "--format", "json"])
+    assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+    doc = json.loads(r.stdout)
+    assert [c["dg"] for c in doc["chunks"]] == [b]
+
+    # Backward from a partition nothing fed exits 2 (resolve-empty).
+    r = run_gated(["lineage", str(led), "--backward", "7"])
+    assert r.returncode == 2
+
+    old = tmp_path / "old.jsonl"
+    _synth_ledger(old, [(0, 10, a, [0])], {0: [a]}, reduce_n=2)
+    r = run_gated(["lineage", "diff", str(old), str(led),
+                   "--format", "json"])
+    assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+    doc = json.loads(r.stdout)
+    assert doc["memo_hit_frac"] == pytest.approx(10 / 30)
